@@ -1,0 +1,29 @@
+"""ConsensusML-TPU: a TPU-native decentralized training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the
+reference framework ``3ickey/ConsensusML`` (CUDA/NCCL; see SURVEY.md — the
+reference mount was empty, so capability parity targets come from
+BASELINE.json's north-star description rather than file:line citations):
+
+- peer-to-peer gossip data parallelism over ring / torus / dense worker
+  topologies (reference: NCCL send/recv -> here: ``jax.lax.ppermute`` over a
+  named TPU mesh on ICI),
+- consensus all-reduce averaging (reference: NCCL all-reduce -> here:
+  ``jax.lax.pmean``),
+- local-SGD inner loop with a model-averaging outer step, compiled as ONE
+  ``jax.jit`` program under ``shard_map``,
+- top-k sparsified and int8-quantized gradient gossip (reference: CUDA
+  kernels -> here: Pallas TPU kernels with jnp reference implementations),
+- a simulated-workers backend (workers as a stacked leading axis on one
+  device; gossip = einsum with the mixing matrix) used as the CPU reference
+  and test oracle for the collective backend.
+"""
+
+__version__ = "0.1.0"
+
+from consensusml_tpu.topology import (  # noqa: F401
+    DenseTopology,
+    RingTopology,
+    Topology,
+    TorusTopology,
+)

@@ -1,0 +1,22 @@
+"""Worker topologies and gossip mixing matrices.
+
+Reference parity: ConsensusML's ring / 2-D torus / dense gossip neighbor
+graphs (BASELINE.json configs; reference file:line unavailable — mount was
+empty, see SURVEY.md). Here a topology is pure math: it yields
+
+- a doubly-stochastic **mixing matrix** ``W`` (used verbatim by the
+  simulated-workers backend: ``x <- W @ x``), and
+- a list of **shifts** — mesh-axis cyclic permutations with weights — which
+  the collective backend lowers to ``jax.lax.ppermute`` calls on a named
+  TPU mesh. Both views are generated from the same edge set, so the two
+  backends compute the *same* mixing operator by construction.
+"""
+
+from consensusml_tpu.topology.topologies import (  # noqa: F401
+    DenseTopology,
+    RingTopology,
+    Shift,
+    Topology,
+    TorusTopology,
+    topology_from_name,
+)

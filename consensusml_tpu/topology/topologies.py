@@ -1,0 +1,244 @@
+"""Gossip topologies: ring, 2-D torus, dense (fully connected).
+
+Each topology describes ``world_size`` workers laid out on a named device
+mesh with shape ``mesh_shape`` and axis names ``axis_names``. The gossip
+averaging step is
+
+    x_i  <-  sum_j W[i, j] * x_j
+
+where ``W`` is doubly stochastic. For ring/torus, ``W`` is built from
+*shifts*: cyclic rotations along mesh axes. A shift with ``offset=+1`` along
+the ring axis means "receive from your left neighbor" and lowers to a single
+``jax.lax.ppermute``. Weights follow the Metropolis-Hastings rule for
+regular graphs: ``1 / (degree + 1)`` per neighbor, remainder on self —
+which maximizes robustness of the spectral gap without per-edge tuning.
+
+Degenerate sizes are handled by *merging* parallel edges (e.g. a ring of 2,
+or a torus dimension of 2, where +1 and -1 reach the same node): the shifts
+are kept as separate ppermutes whose weights simply add, and the mixing
+matrix is accumulated from the same shift list, so both backends agree
+bit-for-bit even in the degenerate cases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "Shift",
+    "Topology",
+    "RingTopology",
+    "TorusTopology",
+    "DenseTopology",
+    "topology_from_name",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Shift:
+    """One weighted cyclic rotation along a mesh axis.
+
+    ``offset=+1`` means worker ``i`` receives the value held by worker
+    ``i - 1`` along ``axis`` (a cyclic right-rotation of the data), matching
+    ``jax.lax.ppermute`` with ``perm=[(s, (s + 1) % n) for s in range(n)]``.
+    """
+
+    axis: int  # index into Topology.axis_names
+    offset: int  # cyclic offset along that axis (non-zero)
+    weight: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Base: a weighted, symmetric, connected gossip graph on a mesh."""
+
+    mesh_shape: tuple[int, ...]
+    axis_names: tuple[str, ...]
+    shifts: tuple[Shift, ...]
+    self_weight: float
+    name: str = "custom"
+
+    def __post_init__(self) -> None:
+        if len(self.mesh_shape) != len(self.axis_names):
+            raise ValueError("mesh_shape and axis_names must align")
+        if any(d < 1 for d in self.mesh_shape):
+            raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
+        total = self.self_weight + sum(s.weight for s in self.shifts)
+        if not np.isclose(total, 1.0):
+            raise ValueError(f"weights must sum to 1, got {total}")
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.mesh_shape))
+
+    # ---- coordinates ----------------------------------------------------
+    def coords(self, rank: int) -> tuple[int, ...]:
+        """Row-major coordinates of ``rank`` on the mesh."""
+        return tuple(np.unravel_index(rank, self.mesh_shape))
+
+    def rank(self, coords: Sequence[int]) -> int:
+        return int(np.ravel_multi_index(tuple(coords), self.mesh_shape, mode="wrap"))
+
+    def neighbors(self, rank: int) -> list[tuple[int, float]]:
+        """(neighbor_rank, weight) pairs worker ``rank`` receives from."""
+        out: dict[int, float] = {}
+        c = self.coords(rank)
+        for s in self.shifts:
+            src = list(c)
+            src[s.axis] = (src[s.axis] - s.offset) % self.mesh_shape[s.axis]
+            r = self.rank(src)
+            out[r] = out.get(r, 0.0) + s.weight
+        return sorted(out.items())
+
+    # ---- mixing matrix --------------------------------------------------
+    def mixing_matrix(self) -> np.ndarray:
+        """Doubly-stochastic ``W`` with ``W[i, j]`` = weight of j's value in
+        i's update. Built from the same shifts the collective backend runs,
+        so the simulated (einsum) and collective (ppermute) backends apply
+        the identical operator."""
+        n = self.world_size
+        w = np.eye(n) * self.self_weight
+        for i in range(n):
+            for j, wt in self.neighbors(i):
+                w[i, j] += wt
+        return w
+
+    def spectral_gap(self) -> float:
+        """``1 - |lambda_2(W)|``: the per-round consensus contraction rate.
+
+        Positive gap <=> gossip converges geometrically to consensus.
+        """
+        # W is symmetric by construction -> eigvalsh (real, sorted, stable)
+        eig = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix())))
+        return float(1.0 - eig[-2]) if len(eig) > 1 else 1.0
+
+    @property
+    def uses_psum(self) -> bool:
+        """Dense topologies lower to one pmean instead of ppermute shifts."""
+        return False
+
+
+def _metropolis_ring(n: int) -> tuple[tuple[Shift, ...], float]:
+    if n == 1:
+        return (), 1.0
+    if n == 2:
+        # +1 and -1 reach the same neighbor; two shifts of weight 1/4 merge
+        # to the Metropolis weight 1/2 on the single edge.
+        return (Shift(0, +1, 0.25), Shift(0, -1, 0.25)), 0.5
+    w = 1.0 / 3.0  # degree 2 -> 1/(2+1)
+    return (Shift(0, +1, w), Shift(0, -1, w)), 1.0 - 2.0 * w
+
+
+class RingTopology(Topology):
+    """1-D ring: each worker averages with its two cyclic neighbors.
+
+    Reference parity: "8-worker ring consensus all-reduce" / ring gossip
+    (BASELINE.json configs[1]; reference NCCL send/recv ring — file:line
+    unavailable, mount empty)."""
+
+    def __init__(self, world_size: int, axis_name: str = "workers"):
+        shifts, self_w = _metropolis_ring(world_size)
+        super().__init__(
+            mesh_shape=(world_size,),
+            axis_names=(axis_name,),
+            shifts=shifts,
+            self_weight=self_w,
+            name="ring",
+        )
+
+
+class TorusTopology(Topology):
+    """2-D torus: 4-neighbor averaging on a (rows x cols) wraparound grid.
+
+    Reference parity: "torus gossip over 4x4 mesh" (BASELINE.json
+    configs[3]). On TPU the two torus axes map directly onto two named mesh
+    axes so every ppermute rides ICI neighbor links."""
+
+    def __init__(self, rows: int, cols: int, axis_names: tuple[str, str] = ("rows", "cols")):
+        if rows < 1 or cols < 1:
+            raise ValueError(f"torus dims must be positive, got {rows}x{cols}")
+        shifts: list[Shift] = []
+        # Actual graph degree: a size-2 axis contributes ONE neighbor (the
+        # +1/-1 shifts merge onto the same edge), size>2 contributes two.
+        degree = sum(1 if s == 2 else (2 if s > 2 else 0) for s in (rows, cols))
+        if degree == 0:
+            super().__init__((1, 1), axis_names, (), 1.0, name="torus")
+            return
+        w = 1.0 / (degree + 1)
+        for axis, size in ((0, rows), (1, cols)):
+            if size == 1:
+                continue
+            if size == 2:
+                # one merged edge of Metropolis weight w, split across the
+                # two equivalent shifts (matches _metropolis_ring(2))
+                shifts += [Shift(axis, +1, w / 2), Shift(axis, -1, w / 2)]
+            else:
+                shifts += [Shift(axis, +1, w), Shift(axis, -1, w)]
+        self_w = 1.0 - sum(s.weight for s in shifts)
+        super().__init__((rows, cols), axis_names, tuple(shifts), self_w, name="torus")
+
+
+class DenseTopology(Topology):
+    """Fully-connected: one round reaches exact consensus (W = 11^T / n).
+
+    Reference parity: "dense gossip" for small worker counts
+    (BASELINE.json configs[0]). Lowers to a single ``jax.lax.pmean``
+    (reference: NCCL all-reduce) instead of n-1 ppermutes."""
+
+    def __init__(self, world_size: int, axis_name: str = "workers"):
+        n = world_size
+        if n < 1:
+            raise ValueError(f"world_size must be positive, got {n}")
+        if n == 1:
+            shifts: tuple[Shift, ...] = ()
+        else:
+            shifts = tuple(Shift(0, off, 1.0 / n) for off in range(1, n))
+        super().__init__(
+            mesh_shape=(n,),
+            axis_names=(axis_name,),
+            shifts=shifts,
+            self_weight=1.0 / n,
+            name="dense",
+        )
+
+    @property
+    def uses_psum(self) -> bool:
+        return True
+
+
+def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:
+    """Build a topology from a CLI-style name: ring | torus | dense.
+
+    For ``torus``, pass ``rows``/``cols`` or let it factor ``world_size``
+    into the squarest grid."""
+    name = name.lower()
+    if world_size < 1:
+        raise ValueError(f"world_size must be positive, got {world_size}")
+    if name in ("ring", "dense"):
+        if kwargs:
+            raise ValueError(f"{name} topology takes no extra args, got {sorted(kwargs)}")
+        return RingTopology(world_size) if name == "ring" else DenseTopology(world_size)
+    if name == "torus":
+        if unknown := set(kwargs) - {"rows", "cols"}:
+            raise ValueError(f"torus topology got unknown args {sorted(unknown)}")
+        rows, cols = kwargs.get("rows"), kwargs.get("cols")
+        if rows is not None and cols is None:
+            if world_size % rows:
+                raise ValueError(f"rows={rows} does not divide world_size={world_size}")
+            cols = world_size // rows
+        elif cols is not None and rows is None:
+            if world_size % cols:
+                raise ValueError(f"cols={cols} does not divide world_size={world_size}")
+            rows = world_size // cols
+        elif rows is None and cols is None:
+            rows = int(np.floor(np.sqrt(world_size)))
+            while world_size % rows:
+                rows -= 1
+            cols = world_size // rows
+        if rows * cols != world_size:
+            raise ValueError(f"torus {rows}x{cols} != world_size {world_size}")
+        return TorusTopology(rows, cols)
+    raise ValueError(f"unknown topology {name!r} (expected ring|torus|dense)")

@@ -1,0 +1,132 @@
+"""Property tests for gossip topologies and mixing matrices.
+
+Covers the reference's topology layer (SURVEY.md L2): doubly-stochastic
+mixing, symmetry, positive spectral gap, and the consensus contraction
+bound ||W x - x_bar|| <= lambda_2 ||x - x_bar||.
+"""
+
+import numpy as np
+import pytest
+
+from consensusml_tpu.topology import (
+    DenseTopology,
+    RingTopology,
+    TorusTopology,
+    topology_from_name,
+)
+
+TOPOLOGIES = [
+    RingTopology(2),
+    RingTopology(3),
+    RingTopology(8),
+    RingTopology(32),
+    TorusTopology(2, 2),
+    TorusTopology(4, 4),
+    TorusTopology(2, 3),
+    TorusTopology(1, 8),
+    DenseTopology(4),
+    DenseTopology(32),
+]
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES, ids=lambda t: f"{t.name}{t.mesh_shape}")
+def test_doubly_stochastic(topo):
+    w = topo.mixing_matrix()
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+    assert (w >= -1e-12).all()
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES, ids=lambda t: f"{t.name}{t.mesh_shape}")
+def test_symmetric(topo):
+    w = topo.mixing_matrix()
+    np.testing.assert_allclose(w, w.T, atol=1e-12)
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES, ids=lambda t: f"{t.name}{t.mesh_shape}")
+def test_spectral_gap_positive(topo):
+    # connected + aperiodic (positive self weight) => gap > 0
+    assert topo.spectral_gap() > 1e-6
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES, ids=lambda t: f"{t.name}{t.mesh_shape}")
+def test_consensus_contraction(topo):
+    """One gossip round contracts disagreement by at least the spectral gap."""
+    rng = np.random.default_rng(0)
+    w = topo.mixing_matrix()
+    lam2 = 1.0 - topo.spectral_gap()
+    for _ in range(5):
+        x = rng.normal(size=(topo.world_size, 7))
+        xbar = x.mean(axis=0, keepdims=True)
+        before = np.linalg.norm(x - xbar)
+        after = np.linalg.norm(w @ x - xbar)
+        assert after <= lam2 * before + 1e-9
+        # mean is preserved exactly by doubly-stochastic mixing
+        np.testing.assert_allclose((w @ x).mean(axis=0), xbar[0], atol=1e-12)
+
+
+def test_dense_one_round_consensus():
+    topo = DenseTopology(4)
+    w = topo.mixing_matrix()
+    np.testing.assert_allclose(w, np.full((4, 4), 0.25), atol=1e-12)
+    assert topo.uses_psum
+
+
+def test_ring_neighbors():
+    topo = RingTopology(8)
+    assert topo.neighbors(0) == [(1, pytest.approx(1 / 3)), (7, pytest.approx(1 / 3))]
+    assert topo.self_weight == pytest.approx(1 / 3)
+
+
+def test_torus_neighbors_4x4():
+    topo = TorusTopology(4, 4)
+    # worker at (1,1) = rank 5 hears from (0,1)=1, (2,1)=9, (1,0)=4, (1,2)=6
+    assert [r for r, _ in topo.neighbors(5)] == [1, 4, 6, 9]
+    for _, wt in topo.neighbors(5):
+        assert wt == pytest.approx(1 / 5)
+
+
+def test_degenerate_sizes():
+    assert RingTopology(1).mixing_matrix() == pytest.approx(np.ones((1, 1)))
+    np.testing.assert_allclose(
+        RingTopology(2).mixing_matrix(), np.full((2, 2), 0.5), atol=1e-12
+    )
+    # torus with a dimension of 2 merges parallel edges and stays stochastic
+    w = TorusTopology(2, 4).mixing_matrix()
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_torus_degenerate_matches_ring():
+    # a size-2 torus axis merges parallel edges with the TRUE Metropolis
+    # weight: torus(1,2) is the same graph (and matrix) as ring(2)
+    np.testing.assert_allclose(
+        TorusTopology(1, 2).mixing_matrix(), RingTopology(2).mixing_matrix()
+    )
+    assert TorusTopology(2, 2).spectral_gap() == pytest.approx(2 / 3)
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        RingTopology(0)
+    with pytest.raises(ValueError):
+        DenseTopology(-1)
+    with pytest.raises(ValueError):
+        TorusTopology(0, 4)
+    with pytest.raises(ValueError):
+        topology_from_name("ring", 8, rows=2)  # bogus kwarg
+    with pytest.raises(ValueError):
+        topology_from_name("torus", 12, rows=5)  # non-divisor
+    with pytest.raises(ValueError):
+        topology_from_name("torus", 0)
+    # single-sided torus spec derives the other dim
+    assert topology_from_name("torus", 12, rows=2).mesh_shape == (2, 6)
+    assert topology_from_name("torus", 12, cols=2).mesh_shape == (6, 2)
+
+
+def test_from_name():
+    assert topology_from_name("ring", 8).name == "ring"
+    assert topology_from_name("dense", 4).uses_psum
+    t = topology_from_name("torus", 16)
+    assert t.mesh_shape == (4, 4)
+    with pytest.raises(ValueError):
+        topology_from_name("hypercube", 8)
