@@ -1,0 +1,33 @@
+"""Communication backends for decentralized gossip on TPU.
+
+Reference parity: ConsensusML's NCCL wrapper layer (SURVEY.md L1; reference
+file:line unavailable — mount empty). The reference does explicit NCCL
+``send``/``recv`` per neighbor and ``all_reduce`` for consensus rounds;
+here the same operators are XLA collectives over a named device mesh:
+
+- neighbor exchange  -> ``jax.lax.ppermute`` (rides ICI neighbor links),
+- dense consensus    -> ``jax.lax.pmean``,
+- the whole gossip round lives INSIDE one jitted ``shard_map`` program, so
+  XLA's latency-hiding scheduler overlaps communication with compute —
+  there is no host-driven message loop to port.
+
+Two interchangeable backends share the mixing operator defined by a
+:class:`~consensusml_tpu.topology.Topology`:
+
+- :mod:`consensusml_tpu.comm.collectives` — per-worker code run under
+  ``shard_map``; scales to real TPU meshes (and virtual CPU meshes).
+- :mod:`consensusml_tpu.comm.simulated` — workers as a stacked leading
+  axis on ONE device; mixing is an einsum with the mixing matrix. This is
+  the CPU-reference backend (BASELINE.json configs[0], "4 simulated
+  workers") and the numerical oracle the collective backend is tested
+  against.
+"""
+
+from consensusml_tpu.comm.mesh import WorkerMesh, local_device_mesh  # noqa: F401
+from consensusml_tpu.comm.collectives import (  # noqa: F401
+    consensus_error,
+    mix,
+    mix_tree,
+    ppermute_shift,
+)
+from consensusml_tpu.comm import simulated  # noqa: F401

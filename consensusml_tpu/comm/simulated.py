@@ -1,0 +1,55 @@
+"""Simulated-workers backend: gossip on ONE device via the mixing matrix.
+
+Reference parity: ConsensusML's CPU-simulated multi-worker mode
+(BASELINE.json configs[0], "4 simulated workers, dense gossip (CPU ref)";
+SURVEY.md L7 — file:line unavailable, mount empty). Workers are a stacked
+leading axis of every array; one gossip round is an einsum with the
+topology's doubly-stochastic mixing matrix. Runs any world size on a single
+device (CPU or one TPU chip), is exactly the operator the collective
+backend implements with ``ppermute``, and therefore doubles as the test
+oracle for it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusml_tpu.topology import Topology
+
+__all__ = ["mixing_matrix", "mix_stacked", "mix_tree_stacked", "consensus_error_stacked"]
+
+
+def mixing_matrix(topology: Topology, dtype=jnp.float32) -> jax.Array:
+    """The topology's mixing matrix as a device array (flat worker order)."""
+    return jnp.asarray(np.asarray(topology.mixing_matrix()), dtype=dtype)
+
+
+def mix_stacked(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x`` has a flat leading worker axis: ``x_i <- sum_j W[i,j] x_j``.
+
+    Accumulates in float32 (matching the collective backend) then casts
+    back to the input dtype.
+    """
+    n = w.shape[0]
+    flat = jnp.asarray(x, jnp.float32).reshape(n, -1)
+    mixed = jnp.asarray(w, jnp.float32) @ flat
+    return mixed.reshape(x.shape).astype(x.dtype)
+
+
+def mix_tree_stacked(tree: Any, w: jax.Array) -> Any:
+    return jax.tree.map(lambda x: mix_stacked(x, w), tree)
+
+
+def consensus_error_stacked(tree: Any, world_size: int) -> jax.Array:
+    """Same metric as :func:`consensusml_tpu.comm.collectives.consensus_error`
+    on stacked arrays: ``sqrt(mean_i ||theta_i - theta_bar||^2)``."""
+    total = jnp.zeros((), jnp.float32)
+    for x in jax.tree.leaves(tree):
+        x = jnp.asarray(x, jnp.float32).reshape(world_size, -1)
+        dev = x - jnp.mean(x, axis=0, keepdims=True)
+        total = total + jnp.sum(dev**2) / world_size
+    return jnp.sqrt(total)

@@ -1,0 +1,147 @@
+"""Compressor interface and payload pytrees.
+
+Payloads are registered pytree nodes whose children are fixed-shape arrays
+— the property that lets a compressed tensor ride ``jax.lax.ppermute`` /
+``all_gather`` like any dense buffer (SURVEY.md §7 "exchanging sparse
+payloads via ppermute: pack to fixed-size buffers").
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Compressor",
+    "TopKPayload",
+    "Int8Payload",
+    "IdentityCompressor",
+    "ComposedCompressor",
+]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class TopKPayload:
+    """Top-k sparse tensor: k signed values + flat int32 indices.
+
+    ``shape``/``dtype`` are static aux data (they never change under jit);
+    ``values``/``indices`` are the wire payload.
+    """
+
+    values: jax.Array  # (k,) in compute dtype (or a nested payload)
+    indices: jax.Array  # (k,) int32 into the flattened tensor
+    shape: tuple[int, ...]
+    dtype: Any
+
+    def tree_flatten(self):
+        return (self.values, self.indices), (self.shape, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Int8Payload:
+    """Per-chunk symmetric int8 quantization: int8 data + f32 chunk scales."""
+
+    data: jax.Array  # (padded_n,) int8
+    scales: jax.Array  # (num_chunks,) float32
+    shape: tuple[int, ...]
+    dtype: Any
+    chunk: int
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.shape, self.dtype, self.chunk)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+
+
+class Compressor(abc.ABC):
+    """Stateless, shape-preserving lossy codec for a single array.
+
+    ``decompress(compress(x))`` has ``x``'s shape and dtype. Compressors
+    are applied leaf-wise over parameter/gradient pytrees by the consensus
+    engine; all shapes in the payload are static at trace time.
+    """
+
+    @abc.abstractmethod
+    def compress(self, x: jax.Array):
+        ...
+
+    @abc.abstractmethod
+    def decompress(self, payload) -> jax.Array:
+        ...
+
+    def wire_bytes(self, shape: tuple[int, ...], dtype) -> int:
+        """Bytes actually exchanged per tensor — for bandwidth accounting."""
+        payload = jax.eval_shape(
+            self.compress, jax.ShapeDtypeStruct(shape, dtype)
+        )
+        return sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(payload)
+        )
+
+    def compress_tree(self, tree: Any) -> Any:
+        return jax.tree.map(self.compress, tree)
+
+    def decompress_tree(self, payload_tree: Any, like: Any) -> Any:
+        """Decompress a payload tree; ``like`` gives the original structure."""
+        flat_payloads = _payload_leaves(payload_tree, like)
+        decompressed = [self.decompress(p) for p in flat_payloads]
+        return jax.tree.unflatten(jax.tree.structure(like), decompressed)
+
+
+def _payload_leaves(payload_tree: Any, like: Any) -> list:
+    """Split a mapped payload tree back into one payload per ``like`` leaf."""
+    structure = jax.tree.structure(like)
+    return jax.tree.structure(like).flatten_up_to(payload_tree) if structure.num_leaves else []
+
+
+class IdentityCompressor(Compressor):
+    """No-op codec: exact gossip expressed through the compressed path."""
+
+    def compress(self, x: jax.Array):
+        return x
+
+    def decompress(self, payload) -> jax.Array:
+        return payload
+
+
+@dataclasses.dataclass(frozen=True)
+class ComposedCompressor(Compressor):
+    """outer(inner): e.g. int8-quantize the values of a top-k payload.
+
+    Reference parity: "top-k sparsified + 8-bit quantized gradient gossip"
+    (BASELINE.json configs[4]). The outer codec is applied to the inner
+    payload's ``values`` leaf only (indices stay exact int32).
+    """
+
+    inner: Compressor  # produces a TopKPayload
+    outer: Compressor  # applied to payload.values
+
+    def compress(self, x: jax.Array):
+        p = self.inner.compress(x)
+        if not isinstance(p, TopKPayload):
+            raise TypeError("ComposedCompressor.inner must produce TopKPayload")
+        return TopKPayload(
+            values=self.outer.compress(p.values),
+            indices=p.indices,
+            shape=p.shape,
+            dtype=p.dtype,
+        )
+
+    def decompress(self, payload) -> jax.Array:
+        values = self.outer.decompress(payload.values)
+        inner_payload = TopKPayload(
+            values=values, indices=payload.indices, shape=payload.shape, dtype=payload.dtype
+        )
+        return self.inner.decompress(inner_payload)

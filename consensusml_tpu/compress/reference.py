@@ -1,0 +1,105 @@
+"""jnp reference implementations of the compression codecs.
+
+These define the numerical semantics that any accelerated (Pallas) kernel
+implementation must match exactly. Reference parity: the CUDA top-k and
+8-bit quantization kernels named in BASELINE.json's north_star (exact CUDA
+semantics unknowable — mount empty; standard formulations used and
+flagged in SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.compress.base import Compressor, Int8Payload, TopKPayload
+
+__all__ = ["TopKCompressor", "Int8Compressor", "topk_int8_compressor"]
+
+
+def _static_k(size: int, ratio: float, k: int | None) -> int:
+    if k is not None:
+        return max(1, min(k, size))
+    return max(1, min(size, int(round(size * ratio))))
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKCompressor(Compressor):
+    """Magnitude top-k sparsification with a STATIC per-tensor k.
+
+    ``k = round(ratio * size)`` is resolved from the (static) tensor shape
+    at trace time, so the payload has fixed shape — XLA-friendly and
+    directly exchangeable via ppermute (SURVEY.md §7 "fixed-k ... static
+    shape"). Selection uses ``jax.lax.top_k`` on magnitudes; on TPU this
+    lowers to an efficient sort-based reduction.
+    """
+
+    ratio: float = 0.01
+    k: int | None = None
+
+    def compress(self, x: jax.Array) -> TopKPayload:
+        flat = x.reshape(-1)
+        k = _static_k(flat.size, self.ratio, self.k)
+        _, idx = jax.lax.top_k(jnp.abs(jnp.asarray(flat, jnp.float32)), k)
+        idx = jnp.asarray(idx, jnp.int32)
+        return TopKPayload(values=flat[idx], indices=idx, shape=x.shape, dtype=x.dtype)
+
+    def decompress(self, payload: TopKPayload) -> jax.Array:
+        n = 1
+        for d in payload.shape:
+            n *= d
+        flat = jnp.zeros((n,), payload.dtype)
+        flat = flat.at[payload.indices].set(jnp.asarray(payload.values, payload.dtype))
+        return flat.reshape(payload.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class Int8Compressor(Compressor):
+    """Symmetric per-chunk affine int8 quantization.
+
+    Per chunk of ``chunk`` consecutive elements (flattened, zero-padded):
+    ``scale = absmax / 127``; ``q = clip(round(x / scale), -127, 127)``.
+    Round-to-nearest-even (jnp.rint semantics). Zero chunks get scale 0 and
+    decode to exact zeros. 4x wire compression for f32 (2x for bf16) plus
+    one f32 scale per chunk.
+    """
+
+    chunk: int = 256
+
+    def compress(self, x: jax.Array) -> Int8Payload:
+        flat = jnp.asarray(x.reshape(-1), jnp.float32)
+        n = flat.size
+        # effective chunk never exceeds the tensor: small leaves (biases,
+        # top-k value vectors with k < chunk) must not balloon to a full
+        # zero-padded chunk on the wire
+        chunk = min(self.chunk, n)
+        pad = (-n) % chunk
+        padded = jnp.pad(flat, (0, pad))
+        chunks = padded.reshape(-1, chunk)
+        absmax = jnp.max(jnp.abs(chunks), axis=1)
+        scales = absmax / 127.0
+        inv = jnp.where(scales > 0, 1.0 / jnp.where(scales > 0, scales, 1.0), 0.0)
+        q = jnp.clip(jnp.rint(chunks * inv[:, None]), -127, 127).astype(jnp.int8)
+        return Int8Payload(
+            data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk
+        )
+
+    def decompress(self, payload: Int8Payload) -> jax.Array:
+        chunks = payload.data.reshape(-1, payload.chunk).astype(jnp.float32)
+        flat = (chunks * payload.scales[:, None]).reshape(-1)
+        n = 1
+        for d in payload.shape:
+            n *= d
+        return flat[:n].astype(payload.dtype).reshape(payload.shape)
+
+
+def topk_int8_compressor(ratio: float = 0.01, chunk: int = 256, k: int | None = None):
+    """Config-5 codec: top-k sparsify, then int8-quantize the k values
+    (BASELINE.json configs[4])."""
+    from consensusml_tpu.compress.base import ComposedCompressor
+
+    return ComposedCompressor(
+        inner=TopKCompressor(ratio=ratio, k=k), outer=Int8Compressor(chunk=chunk)
+    )

@@ -1,0 +1,17 @@
+"""Consensus/averaging engine: exact and compressed gossip over pytrees.
+
+Reference parity: ConsensusML's gossip engine layer (SURVEY.md L3) — the
+step that applies the topology's mixing to model state, with compression
+at the communication boundary (BASELINE.json north_star). The compressed
+path follows the CHOCO-SGD scheme (Koloskova et al., 2019: decentralized
+SGD with arbitrary compressed communication): each worker gossips only the
+compressed innovation ``Q(x - xhat)``, so the wire payload stays small
+while consensus still converges; plain gossip is the identity-compressor
+special case.
+"""
+
+from consensusml_tpu.consensus.engine import (  # noqa: F401
+    ChocoState,
+    ConsensusEngine,
+    GossipConfig,
+)

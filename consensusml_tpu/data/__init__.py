@@ -1,0 +1,15 @@
+"""Data pipelines: per-worker sharded batch streams.
+
+Reference parity: the per-workload dataloaders (SURVEY.md L5; mount
+empty). This environment has no network access, so image/text datasets are
+procedurally generated with the same shapes and a learnable structure —
+the decentralized-training math (gossip, consensus, local SGD) is dataset-
+agnostic. Loaders yield STACKED round batches of shape ``(W, H, B, ...)``:
+one microbatch per inner step per worker, each worker sampling from its own
+disjoint shard (the reference's data-parallel partitioning).
+"""
+
+from consensusml_tpu.data.synthetic import (  # noqa: F401
+    SyntheticClassification,
+    round_batches,
+)

@@ -1,0 +1,81 @@
+"""Procedural datasets with MNIST/CIFAR shapes.
+
+Class-prototype + noise classification: class k's images cluster around a
+fixed random prototype, so a small model reaches high accuracy quickly —
+ideal for convergence smoke tests (the reference's MNIST role, SURVEY.md
+§4) while requiring zero network access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SyntheticClassification", "round_batches"]
+
+
+@dataclasses.dataclass
+class SyntheticClassification:
+    """Deterministic synthetic classification dataset, sharded by worker."""
+
+    n: int = 8192
+    image_shape: tuple[int, ...] = (28, 28, 1)
+    classes: int = 10
+    noise: float = 0.35
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.default_rng(self.seed)
+        self.prototypes = rng.normal(size=(self.classes, *self.image_shape)).astype(
+            np.float32
+        )
+        self.labels = rng.integers(0, self.classes, size=self.n).astype(np.int32)
+        self.images = (
+            self.prototypes[self.labels]
+            + self.noise * rng.normal(size=(self.n, *self.image_shape))
+        ).astype(np.float32)
+
+    def worker_shard(self, rank: int, world_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """Disjoint contiguous shard for one worker (reference-style DP
+        partitioning)."""
+        per = self.n // world_size
+        lo = rank * per
+        return self.images[lo : lo + per], self.labels[lo : lo + per]
+
+    def eval_batch(self, size: int = 1024) -> dict[str, jnp.ndarray]:
+        return {
+            "image": jnp.asarray(self.images[:size]),
+            "label": jnp.asarray(self.labels[:size]),
+        }
+
+
+def round_batches(
+    dataset: SyntheticClassification,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+) -> Iterator[dict[str, jnp.ndarray]]:
+    """Yield ``rounds`` stacked round-batches of shape ``(W, H, B, ...)``.
+
+    Every worker samples uniformly (with replacement) from its OWN shard —
+    workers see disjoint data, which is what makes their replicas drift and
+    gives the consensus step something to do.
+    """
+    shards = [dataset.worker_shard(r, world_size) for r in range(world_size)]
+    rng = np.random.default_rng(seed)
+    for _ in range(rounds):
+        imgs = np.empty(
+            (world_size, h, batch, *dataset.image_shape), np.float32
+        )
+        labs = np.empty((world_size, h, batch), np.int32)
+        for r, (x, y) in enumerate(shards):
+            idx = rng.integers(0, len(x), size=(h, batch))
+            imgs[r] = x[idx]
+            labs[r] = y[idx]
+        yield {"image": jnp.asarray(imgs), "label": jnp.asarray(labs)}

@@ -1,0 +1,12 @@
+"""Model zoo: the workloads the reference framework ships.
+
+Target inventory (BASELINE.json configs; SURVEY.md L5 — mount empty):
+2-layer MLP (MNIST), ResNet-50 (CIFAR-10/ImageNet-class), BERT-base MLM,
+GPT-2-medium, Llama-2-7B with LoRA — flax.linen modules written TPU-first:
+bf16-friendly, static shapes, MXU-sized matmuls. Import errors below mean
+that family hasn't landed yet; the ``__init__`` exports are the source of
+truth for what exists.
+"""
+
+from consensusml_tpu.models.mlp import MLP, mlp_loss_fn  # noqa: F401
+from consensusml_tpu.models.losses import softmax_cross_entropy  # noqa: F401

@@ -1,0 +1,26 @@
+"""Shared loss functions (computed in float32 regardless of param dtype)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["softmax_cross_entropy", "masked_lm_loss"]
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross-entropy over the batch; labels are int class ids."""
+    logits = jnp.asarray(logits, jnp.float32)
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+
+def masked_lm_loss(logits: jax.Array, labels: jax.Array, mask: jax.Array) -> jax.Array:
+    """Cross-entropy over masked positions only (BERT-MLM / causal LM).
+
+    ``mask`` is 1.0 where the position contributes to the loss.
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    mask = jnp.asarray(mask, jnp.float32)
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,46 @@
+"""2-layer MLP for MNIST-class workloads.
+
+Reference parity: "2-layer MLP on MNIST" (BASELINE.json configs[0];
+SURVEY.md L5 — mount empty, exact reference hyperparameters unknown).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.losses import softmax_cross_entropy
+
+__all__ = ["MLP", "mlp_loss_fn"]
+
+
+class MLP(nn.Module):
+    """Flatten -> Dense(hidden) -> relu -> Dense(classes)."""
+
+    hidden: int = 256
+    classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = jnp.asarray(x, self.dtype).reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.classes, dtype=self.dtype)(x)
+
+
+def mlp_loss_fn(model: MLP):
+    """``loss_fn(params, batch, rng)`` for the local-SGD trainer.
+
+    ``batch`` is ``{"image": (B, ...), "label": (B,)}``; rng unused (no
+    dropout in the 2-layer MLP).
+    """
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        return softmax_cross_entropy(logits, batch["label"])
+
+    return loss_fn

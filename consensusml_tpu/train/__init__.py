@@ -1,0 +1,22 @@
+"""Decentralized local-SGD training loop.
+
+Reference parity: ConsensusML's training layer (SURVEY.md L4) — each worker
+runs H local optimizer steps ("inner loop"), then a model-averaging outer
+step over the gossip topology (BASELINE.json: "local-SGD inner loop and
+model-averaging outer step", configs[2] "32-worker local-SGD (H=8)").
+
+TPU-first design (north_star): the ENTIRE round — H forward/backward +
+optimizer steps via ``lax.scan``, then the gossip collective — is ONE
+``jax.jit``-compiled program under ``shard_map``, so XLA overlaps the
+mixing collectives with compute and there is no host round-trip between
+inner steps (the reference crosses the host boundary at every NCCL call).
+"""
+
+from consensusml_tpu.train.local_sgd import (  # noqa: F401
+    LocalSGDConfig,
+    TrainState,
+    make_collective_train_step,
+    make_simulated_train_step,
+    init_state,
+    init_stacked_state,
+)

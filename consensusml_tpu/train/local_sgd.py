@@ -1,0 +1,244 @@
+"""Local-SGD train step builders for both execution backends.
+
+``loss_fn(params, batch, rng) -> scalar loss`` is user code (a model from
+:mod:`consensusml_tpu.models` or anything else). A *round* consumes a
+batch of shape ``(H, B, ...)`` per worker: H microbatches for the inner
+loop, then one gossip round, then the consensus-error measurement — all in
+one XLA program.
+
+Collective backend: per-worker code wrapped in ``shard_map`` over the
+topology's mesh; global arrays carry the mesh's leading worker axes.
+Simulated backend: ``vmap`` over a flat leading worker axis on one device,
+gossip via the mixing matrix. Cross-validated in tests/test_local_sgd.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from consensusml_tpu.comm import WorkerMesh, simulated
+from consensusml_tpu.consensus import ChocoState, ConsensusEngine, GossipConfig
+
+__all__ = [
+    "LocalSGDConfig",
+    "TrainState",
+    "init_state",
+    "init_stacked_state",
+    "make_collective_train_step",
+    "make_simulated_train_step",
+]
+
+LossFn = Callable[[Any, Any, jax.Array], jax.Array]
+
+
+class TrainState(NamedTuple):
+    step: jax.Array  # outer-round counter
+    params: Any
+    opt_state: Any
+    gossip: ChocoState | None
+    rng: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalSGDConfig:
+    """One decentralized training round = H local steps + one gossip round."""
+
+    gossip: GossipConfig
+    optimizer: optax.GradientTransformation
+    h: int = 1  # local (inner) steps between gossip rounds
+
+    def engine(self) -> ConsensusEngine:
+        return ConsensusEngine(self.gossip)
+
+
+# ---------------------------------------------------------------------------
+# state init
+# ---------------------------------------------------------------------------
+
+
+def init_state(cfg: LocalSGDConfig, params: Any, rng: jax.Array) -> TrainState:
+    """Per-worker (unstacked) state — used inside the collective backend."""
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=cfg.optimizer.init(params),
+        gossip=cfg.engine().init_state(params),
+        rng=rng,
+    )
+
+
+def init_stacked_state(
+    cfg: LocalSGDConfig, init_params: Callable[[jax.Array], Any], rng: jax.Array, world_size: int
+) -> TrainState:
+    """Stacked state with per-worker independent inits (simulated backend,
+    or host-side construction for the collective backend).
+
+    Each worker gets its own init rng — decentralized training starts from
+    DISAGREEING replicas and consensus pulls them together (that is the
+    point of the consensus-error metric).
+    """
+    rngs = jax.random.split(rng, world_size)
+    params = jax.vmap(init_params)(rngs)
+    opt_state = jax.vmap(cfg.optimizer.init)(params)
+    return TrainState(
+        # per-worker step counter so every leaf carries the worker axis
+        # (required for sharding under the collective backend)
+        step=jnp.zeros((world_size,), jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        gossip=cfg.engine().init_state(params),
+        rng=jax.vmap(jax.random.fold_in, in_axes=(0, None))(rngs, 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared inner loop
+# ---------------------------------------------------------------------------
+
+
+def _inner_loop(cfg: LocalSGDConfig, loss_fn: LossFn, params, opt_state, rng, batch):
+    """H local optimizer steps via lax.scan. ``batch`` leaves: (H, ...)."""
+    for leaf in jax.tree.leaves(batch):
+        if leaf.shape[0] != cfg.h:
+            raise ValueError(
+                f"batch leading (inner-step) axis is {leaf.shape[0]} but "
+                f"LocalSGDConfig.h={cfg.h}; each round batch must carry "
+                "exactly h microbatches per worker"
+            )
+
+    def body(carry, microbatch):
+        params, opt_state, rng = carry
+        rng, sub = jax.random.split(rng)
+        loss, grads = jax.value_and_grad(loss_fn)(params, microbatch, sub)
+        updates, opt_state = cfg.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, rng), loss
+
+    (params, opt_state, rng), losses = jax.lax.scan(
+        body, (params, opt_state, rng), batch
+    )
+    return params, opt_state, rng, jnp.mean(losses)
+
+
+# ---------------------------------------------------------------------------
+# collective backend
+# ---------------------------------------------------------------------------
+
+
+def _squeeze(tree: Any, n_axes: int) -> Any:
+    return jax.tree.map(lambda x: x.reshape(x.shape[n_axes:]), tree)
+
+
+def _unsqueeze(tree: Any, n_axes: int) -> Any:
+    return jax.tree.map(lambda x: x.reshape((1,) * n_axes + x.shape), tree)
+
+
+def make_collective_train_step(
+    cfg: LocalSGDConfig, loss_fn: LossFn, wmesh: WorkerMesh
+) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the jitted global train step for a device mesh.
+
+    Inputs are GLOBAL stacked arrays with a FLAT leading worker axis —
+    every ``TrainState`` leaf and batch leaf is ``(W, ...)`` in row-major
+    rank order, exactly as :func:`init_stacked_state` and the data loaders
+    produce (the same layout the simulated backend consumes, so the two
+    backends are drop-in interchangeable). For multi-axis topologies
+    (torus) the step reshapes ``W -> mesh_shape`` inside jit; with the
+    sharding from :meth:`WorkerMesh.stacked_sharding` that reshape is
+    layout-preserving (no data movement). Returns ``(new_state, metrics)``
+    with replicated scalar metrics: mean loss and post-gossip consensus
+    error — the reference's headline pair.
+    """
+    engine = cfg.engine()
+    topo = wmesh.topology
+    mesh_shape = topo.mesh_shape
+    n_axes = len(mesh_shape)
+    world = topo.world_size
+    worker = P(*topo.axis_names)
+
+    to_mesh = lambda t: jax.tree.map(
+        lambda x: x.reshape(*mesh_shape, *x.shape[1:]), t
+    )
+    to_flat = lambda t: jax.tree.map(
+        lambda x: x.reshape(world, *x.shape[n_axes:]), t
+    )
+
+    @jax.shard_map(
+        mesh=wmesh.mesh,
+        in_specs=(worker, worker),
+        out_specs=(worker, P()),
+    )
+    def sharded_round(state: TrainState, batch: Any):
+        state = _squeeze(state, n_axes)
+        batch = _squeeze(batch, n_axes)
+        params, opt_state, rng, loss = _inner_loop(
+            cfg, loss_fn, state.params, state.opt_state, state.rng, batch
+        )
+        params, gossip = engine.round_collective(params, state.gossip)
+        err = engine.consensus_error_collective(params)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            gossip=gossip,
+            rng=rng,
+        )
+        metrics = {
+            "loss": jax.lax.pmean(loss, topo.axis_names),
+            "consensus_error": err,
+        }
+        return _unsqueeze(new_state, n_axes), metrics
+
+    @jax.jit
+    def train_step(state: TrainState, batch: Any):
+        new_state, metrics = sharded_round(to_mesh(state), to_mesh(batch))
+        return to_flat(new_state), metrics
+
+    return train_step
+
+
+# ---------------------------------------------------------------------------
+# simulated backend
+# ---------------------------------------------------------------------------
+
+
+def make_simulated_train_step(
+    cfg: LocalSGDConfig, loss_fn: LossFn
+) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the jitted train step for stacked workers on ONE device.
+
+    State/batch leaves carry a flat leading worker axis (N, ...). The inner
+    loop vmaps over workers; gossip is an einsum with the mixing matrix.
+    Reference parity: the CPU-simulated-workers mode (BASELINE.json
+    configs[0]).
+    """
+    engine = cfg.engine()
+    topo = cfg.gossip.topology
+    w = simulated.mixing_matrix(topo)
+
+    @jax.jit
+    def train_step(state: TrainState, batch: Any):
+        def worker(params, opt_state, rng, batch):
+            return _inner_loop(cfg, loss_fn, params, opt_state, rng, batch)
+
+        params, opt_state, rng, losses = jax.vmap(worker)(
+            state.params, state.opt_state, state.rng, batch
+        )
+        params, gossip = engine.round_simulated(params, state.gossip, w)
+        err = engine.consensus_error_simulated(params)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            gossip=gossip,
+            rng=rng,
+        )
+        return new_state, {"loss": jnp.mean(losses), "consensus_error": err}
+
+    return train_step

@@ -3,12 +3,19 @@
 This mirrors the reference's CPU-simulated-workers test backend
 (BASELINE.json configs[0]): multi-worker gossip semantics are validated
 without a TPU pod by forcing the XLA host platform to expose 8 devices.
-Must run before the first ``import jax`` anywhere in the test process.
+
+Note: this box's axon TPU plugin (sitecustomize in /root/.axon_site)
+force-sets ``jax_platforms="axon,cpu"`` at interpreter start, overriding
+the JAX_PLATFORMS env var — so we must ALSO override via jax.config after
+import. XLA_FLAGS still must be set before the first jax import.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

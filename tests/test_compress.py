@@ -1,0 +1,113 @@
+"""Tests for the compression codecs (jnp reference implementations).
+
+Covers the reference's CUDA kernel layer semantics (SURVEY.md L0):
+round-trip correctness, static payload shapes, wire-size accounting, and
+jit/vmap compatibility (payloads must ride ppermute, so they must be
+well-formed pytrees under transformation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.compress import (
+    Int8Compressor,
+    TopKCompressor,
+    topk_int8_compressor,
+)
+
+
+def test_topk_selects_largest_magnitude():
+    x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05, 0.0])
+    p = TopKCompressor(k=2).compress(x)
+    assert sorted(np.asarray(p.indices).tolist()) == [1, 3]
+    out = TopKCompressor(k=2).decompress(p)
+    np.testing.assert_allclose(out, [0.0, -5.0, 0.0, 3.0, 0.0, 0.0])
+
+
+def test_topk_static_shapes_and_ratio():
+    x = jnp.zeros((64, 32))
+    comp = TopKCompressor(ratio=0.01)
+    p = jax.eval_shape(comp.compress, x)
+    assert p.values.shape == (int(round(64 * 32 * 0.01)),)
+    assert p.indices.dtype == jnp.int32
+    # k never collapses to zero
+    p1 = jax.eval_shape(TopKCompressor(ratio=1e-9).compress, jnp.zeros(10))
+    assert p1.values.shape == (1,)
+
+
+def test_topk_preserves_dtype_and_shape():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(7, 9)), jnp.bfloat16)
+    comp = TopKCompressor(ratio=0.25)
+    out = comp.decompress(comp.compress(x))
+    assert out.shape == x.shape and out.dtype == x.dtype
+
+
+def test_topk_under_jit_and_vmap():
+    comp = TopKCompressor(ratio=0.5)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 20)), jnp.float32)
+    roundtrip = lambda v: comp.decompress(comp.compress(v))
+    got = jax.jit(jax.vmap(roundtrip))(x)
+    want = np.stack([np.asarray(roundtrip(row)) for row in x])
+    np.testing.assert_allclose(got, want)
+
+
+def test_int8_roundtrip_error_bound():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(1000,)), jnp.float32)
+    comp = Int8Compressor(chunk=128)
+    p = comp.compress(x)
+    assert p.data.dtype == jnp.int8
+    out = comp.decompress(p)
+    # max error per element <= scale/2 = absmax/254 per chunk
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    scales = np.asarray(p.scales)
+    bound = np.repeat(scales, 128)[: x.size] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_int8_zero_chunks_and_padding():
+    x = jnp.concatenate([jnp.zeros(300), jnp.ones(50)])  # pads to 512 w/ chunk 256
+    comp = Int8Compressor(chunk=256)
+    out = comp.decompress(comp.compress(x))
+    np.testing.assert_allclose(out, x, atol=1e-6)
+    assert out.shape == (350,)
+
+
+def test_int8_exact_at_extremes():
+    """absmax elements quantize exactly (q = +/-127)."""
+    x = jnp.asarray([-4.0, 2.0, 4.0, 1.0])
+    comp = Int8Compressor(chunk=4)
+    p = comp.compress(x)
+    out = comp.decompress(p)
+    assert float(out[0]) == pytest.approx(-4.0)
+    assert float(out[2]) == pytest.approx(4.0)
+
+
+def test_composed_topk_int8():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(512,)) * 10, jnp.float32)
+    comp = topk_int8_compressor(ratio=0.125, chunk=64)
+    p = comp.compress(x)
+    assert p.values.data.dtype == jnp.int8  # nested payload: int8 of topk values
+    out = comp.decompress(p)
+    # support = top-64 magnitudes, values within int8 error of originals
+    idx = np.asarray(p.indices)
+    dense = np.asarray(x)
+    np.testing.assert_allclose(
+        np.asarray(out)[idx], dense[idx], atol=np.abs(dense).max() / 100
+    )
+    mask = np.ones(512, bool)
+    mask[idx] = False
+    assert np.all(np.asarray(out)[mask] == 0)
+
+
+def test_wire_bytes_accounting():
+    comp = TopKCompressor(ratio=0.01)
+    dense_bytes = 10000 * 4
+    wire = comp.wire_bytes((100, 100), jnp.float32)
+    assert wire == 100 * 4 + 100 * 4  # 100 f32 values + 100 i32 indices
+    assert wire < dense_bytes / 10
+    q = Int8Compressor(chunk=256).wire_bytes((100, 100), jnp.float32)
+    assert q == 10240 * 1 + 40 * 4  # padded int8 data + 40 f32 scales
