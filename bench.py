@@ -1,0 +1,152 @@
+"""Headline benchmark: ResNet-50 decentralized train-step throughput.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+
+Metric definition (BASELINE.json): "imgs/sec/chip + consensus-error
+(ResNet-50, 32-worker gossip)". On this box exactly ONE TPU chip is
+reachable, so the measurement is the per-chip number: one worker's full
+local-SGD round (forward + backward + optimizer + gossip code path) on
+ResNet-50 @ 224x224 bf16 — per-chip throughput is what "imgs/sec/chip"
+normalizes to on any pod size, and the gossip collectives ride ICI links
+that don't exist on a single chip. The consensus-error half of the metric
+is measured by the multi-worker tests/CLI on the virtual CPU mesh.
+
+vs_baseline: BASELINE.json carries NO published reference number
+(`published: {}` — see BASELINE.md). Until a real number exists, the ratio
+is computed against a PROXY of 2500 imgs/sec/chip, a round public
+MLPerf-class figure for ResNet-50 training on one A100 — the reference's
+hardware. It is labeled in the "note" field; replace when the reference
+number becomes recoverable.
+
+A watchdog subprocess guards against a hung TPU tunnel (observed in this
+environment): if the inner run doesn't finish in BENCH_TIMEOUT seconds
+(default 2400), we report value 0 with a note rather than hanging the
+driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROXY_BASELINE_IMGS_SEC_CHIP = 2500.0
+
+
+def _inner(batch: int, steps: int, image: int) -> dict:
+    import jax
+
+    if os.environ.get("BENCH_DEVICE"):  # e.g. "cpu" to bypass a dead TPU tunnel
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from consensusml_tpu.consensus import GossipConfig
+    from consensusml_tpu.models import resnet50, resnet_init, resnet_loss_fn
+    from consensusml_tpu.topology import RingTopology
+    from consensusml_tpu.train import (
+        LocalSGDConfig,
+        init_stacked_state,
+        make_simulated_train_step,
+    )
+
+    dev = jax.devices()[0]
+    model = resnet50(num_classes=1000, stem="imagenet", dtype=jnp.bfloat16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=RingTopology(1)),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        h=1,
+    )
+    step = make_simulated_train_step(cfg, resnet_loss_fn(model))
+    state = init_stacked_state(
+        cfg, resnet_init(model, (1, image, image, 3)), jax.random.key(0), 1
+    )
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "image": jnp.asarray(
+            rng.normal(size=(1, 1, batch, image, image, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(1, 1, batch)), jnp.int32),
+    }
+
+    # compile + warmup
+    t0 = time.time()
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics)
+    compile_s = time.time() - t0
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics)
+
+    t0 = time.time()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics)
+    dt = time.time() - t0
+    imgs_sec = batch * steps / dt
+    return {
+        "imgs_sec": imgs_sec,
+        "compile_s": compile_s,
+        "step_ms": 1000 * dt / steps,
+        "device": str(dev),
+        "platform": jax.default_backend(),
+        "loss": float(metrics["loss"]),
+    }
+
+
+def main() -> None:
+    if "--_inner" in sys.argv:
+        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        image = int(os.environ.get("BENCH_IMAGE", "224"))
+        print("INNER_RESULT " + json.dumps(_inner(batch, steps, image)), flush=True)
+        return
+
+    timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_inner"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        result = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("INNER_RESULT "):
+                result = json.loads(line[len("INNER_RESULT "):])
+        if result is None:
+            raise RuntimeError(
+                f"bench inner failed (rc={proc.returncode}): {proc.stderr[-800:]}"
+            )
+        value = result["imgs_sec"]
+        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        image = int(os.environ.get("BENCH_IMAGE", "224"))
+        note = (
+            f"ResNet-50 local-SGD round on {result['device']} "
+            f"({result['platform']}), batch {batch} @ {image}px, "
+            f"step {result['step_ms']:.1f}ms, "
+            f"compile {result['compile_s']:.0f}s; vs_baseline uses PROXY "
+            f"2500 imgs/s/chip (no published reference number, see BASELINE.md)"
+        )
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        value = 0.0
+        note = f"bench failed: {type(e).__name__}: {str(e)[:300]}"
+    print(
+        json.dumps(
+            {
+                "metric": "imgs/sec/chip (ResNet-50 consensus-SGD, bf16 224px)",
+                "value": round(value, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(value / PROXY_BASELINE_IMGS_SEC_CHIP, 4),
+                "note": note,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

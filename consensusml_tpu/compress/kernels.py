@@ -1,0 +1,310 @@
+"""Pallas TPU kernels for the compression hot paths.
+
+Reference parity: the CUDA gradient-compression kernels (BASELINE.json
+north_star: "CUDA gradient-compression and top-k sparsification kernels
+become Pallas kernels"; SURVEY.md L0 — mount empty). Numerical semantics
+are defined by :mod:`consensusml_tpu.compress.reference` and enforced by
+parity tests (tests/test_kernels.py).
+
+Layout strategy: tensors are flattened and chunked to ``(nchunks, chunk)``
+with ``chunk`` a multiple of 128 (VPU lane width). Each grid step processes
+a sublane-aligned row-block entirely in VMEM:
+
+- int8 quantize: rowwise absmax -> scale -> round-to-nearest-even, one
+  pass, fused (the reference needs separate absmax + quantize CUDA
+  launches; here it is one VMEM-resident kernel).
+- chunked top-k: per chunk, k iterative max-extractions on the VPU
+  (k passes over a VMEM-resident row — no full sort, no HBM traffic).
+
+On non-TPU backends the same kernels run under the Pallas interpreter
+(tests), and the ``auto`` dispatch falls back to the jnp reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consensusml_tpu.compress.base import Compressor, Int8Payload, TopKPayload
+
+__all__ = [
+    "ChunkedTopKCompressor",
+    "PallasInt8Compressor",
+    "quantize_int8",
+    "dequantize_int8",
+    "chunked_topk",
+]
+
+_LANE = 128
+_SUBLANE_F32 = 8
+_SUBLANE_I8 = 32
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _round_up(a: int, b: int) -> int:
+    return _cdiv(a, b) * b
+
+
+# ---------------------------------------------------------------------------
+# int8 quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:]
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q_ref[:] = jnp.clip(jnp.rint(x * inv), -127, 127).astype(jnp.int8)
+    s_ref[:] = scale
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8(chunks: jax.Array, *, interpret: bool = False):
+    """Quantize ``(nchunks, chunk)`` f32 rows to int8 + per-row scales.
+
+    Returns ``(q (nchunks, chunk) int8, scales (nchunks,) f32)``. ``chunk``
+    must be a multiple of 128; rows are padded to the int8 sublane multiple
+    internally and sliced back.
+    """
+    nchunks, chunk = chunks.shape
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = min(rows, 256)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
+    q, scales = pl.pallas_call(
+        _quant_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, chunk), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(chunks)
+    return q[:nchunks], scales[:nchunks, 0]
+
+
+def _dequant_kernel(q_ref, s_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequantize_int8(q: jax.Array, scales: jax.Array, *, interpret: bool = False):
+    """Inverse of :func:`quantize_int8`."""
+    nchunks, chunk = q.shape
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = min(rows, 256)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        q = jnp.pad(q, ((0, rows - nchunks), (0, 0)))
+        scales = jnp.pad(scales, (0, rows - nchunks))
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, chunk), jnp.float32),
+        interpret=interpret,
+    )(q, scales.reshape(-1, 1))
+    return out[:nchunks]
+
+
+# ---------------------------------------------------------------------------
+# chunked top-k
+# ---------------------------------------------------------------------------
+
+
+def _topk_kernel(k: int, x_ref, vals_ref, idx_ref):
+    """Per row: k iterative max-|x| extractions (first index wins ties)."""
+    x = x_ref[:]  # (R, m) f32
+    rows, m = x.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (rows, m), 1)
+
+    def body(j, x_abs):
+        rowmax = jnp.max(x_abs, axis=1, keepdims=True)
+        # first column index attaining the max
+        hit = x_abs == rowmax
+        idx = jnp.min(jnp.where(hit, col, m), axis=1, keepdims=True)  # (R,1)
+        taken = col == idx
+        val = jnp.sum(jnp.where(taken, x, 0.0), axis=1, keepdims=True)
+        vals_ref[:, pl.ds(j, 1)] = val
+        idx_ref[:, pl.ds(j, 1)] = idx
+        # mask the taken column out for the next extraction
+        return jnp.where(taken, -1.0, x_abs)
+
+    jax.lax.fori_loop(0, k, body, jnp.abs(x))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def chunked_topk(chunks: jax.Array, k: int, *, interpret: bool = False):
+    """Top-k by magnitude per row of ``(nchunks, chunk)``.
+
+    Returns ``(values (nchunks, k), local_indices (nchunks, k) int32)``,
+    ordered by decreasing magnitude, ties broken toward lower index —
+    matching ``jax.lax.top_k`` on magnitudes.
+    """
+    nchunks, chunk = chunks.shape
+    rows = _round_up(max(nchunks, _SUBLANE_F32), _SUBLANE_F32)
+    block_rows = min(rows, 64)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
+    kpad = _round_up(k, _LANE)
+    vals, idx = pl.pallas_call(
+        functools.partial(_topk_kernel, k),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, kpad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, kpad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((rows, kpad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(chunks)
+    return vals[:nchunks, :k], idx[:nchunks, :k]
+
+
+# ---------------------------------------------------------------------------
+# codec classes (drop-in Compressor implementations)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if _on_tpu() else "jnp"
+    return impl
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasInt8Compressor(Compressor):
+    """Per-chunk symmetric int8 codec backed by the Pallas kernels.
+
+    ``impl``: "pallas" (compiled), "interpret" (Pallas interpreter — for
+    CPU tests), "jnp" (reference math), or "auto" (pallas on TPU, jnp
+    elsewhere). All produce identical payloads.
+    """
+
+    chunk: int = 512
+    impl: str = "auto"
+
+    def __post_init__(self):
+        if self.chunk % _LANE:
+            raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
+
+    def compress(self, x: jax.Array) -> Int8Payload:
+        n = x.size
+        chunk = min(self.chunk, _round_up(n, _LANE))
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Int8Compressor
+
+            return Int8Compressor(chunk=chunk).compress(x)
+        flat = jnp.asarray(x.reshape(-1), jnp.float32)
+        pad = (-n) % chunk
+        chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+        q, scales = quantize_int8(chunks, interpret=impl == "interpret")
+        return Int8Payload(
+            data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk
+        )
+
+    def decompress(self, payload: Int8Payload) -> jax.Array:
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Int8Compressor
+
+            return Int8Compressor(chunk=payload.chunk).decompress(payload)
+        q = payload.data.reshape(-1, payload.chunk)
+        flat = dequantize_int8(
+            q, payload.scales, interpret=impl == "interpret"
+        ).reshape(-1)
+        n = 1
+        for d in payload.shape:
+            n *= d
+        return flat[:n].astype(payload.dtype).reshape(payload.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedTopKCompressor(Compressor):
+    """Per-chunk (local) top-k sparsification.
+
+    Unlike the global :class:`~consensusml_tpu.compress.TopKCompressor`
+    (one exact top-k over the whole tensor via ``lax.top_k``), this selects
+    ``k_per_chunk`` winners in every ``chunk``-sized block — the standard
+    bandwidth/quality trade used by large-scale top-k systems, and the
+    shape that maps onto a single-pass TPU kernel (each block's candidates
+    never leave VMEM). Payload indices are global (chunk offset added), so
+    decompression is the shared scatter.
+    """
+
+    chunk: int = 512
+    k_per_chunk: int = 16
+    impl: str = "auto"
+
+    def __post_init__(self):
+        if self.chunk % _LANE:
+            raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
+        if not 0 < self.k_per_chunk <= self.chunk:
+            raise ValueError("k_per_chunk must be in (0, chunk]")
+
+    def compress(self, x: jax.Array) -> TopKPayload:
+        flat = jnp.asarray(x.reshape(-1), jnp.float32)
+        n = flat.size
+        chunk = min(self.chunk, _round_up(n, _LANE))
+        k = min(self.k_per_chunk, chunk)
+        pad = (-n) % chunk
+        chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            _, lidx = jax.lax.top_k(jnp.abs(chunks), k)
+            lidx = jnp.asarray(lidx, jnp.int32)
+            vals = jnp.take_along_axis(chunks, lidx, axis=1)
+        else:
+            vals, lidx = chunked_topk(chunks, k, interpret=impl == "interpret")
+        offsets = (jnp.arange(chunks.shape[0], dtype=jnp.int32) * chunk)[:, None]
+        gidx = (lidx + offsets).reshape(-1)
+        # padded tail indices may point past n; clamp to a real slot and
+        # zero their values so decompress scatters nothing
+        valid = gidx < n
+        gidx = jnp.where(valid, gidx, 0)
+        values = jnp.where(valid, vals.reshape(-1), 0.0)
+        return TopKPayload(
+            values=values.astype(x.dtype), indices=gidx, shape=x.shape, dtype=x.dtype
+        )
+
+    def decompress(self, payload: TopKPayload) -> jax.Array:
+        n = 1
+        for d in payload.shape:
+            n *= d
+        flat = jnp.zeros((n,), payload.dtype)
+        flat = flat.at[payload.indices].add(jnp.asarray(payload.values, payload.dtype))
+        return flat.reshape(payload.shape)

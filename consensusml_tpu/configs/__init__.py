@@ -1,0 +1,249 @@
+"""The five reference workload configs, runnable at two scales.
+
+Reference parity: BASELINE.json ``configs`` — each entry below reproduces
+one of them (SURVEY.md L6 config system; mount empty, so hyperparameters
+are standard-literature defaults, flagged as approximations):
+
+  mnist_mlp      — "2-layer MLP on MNIST, 4 simulated workers, dense gossip"
+  cifar_resnet50 — "ResNet-50 on CIFAR-10, 8-worker ring consensus all-reduce"
+  bert_mlm       — "BERT-base MLM, 32-worker local-SGD (H=8) + periodic averaging"
+  llama_lora     — "Llama-2-7B LoRA fine-tune, torus gossip over 4x4 mesh"
+  gpt2_topk      — "GPT-2-medium pretrain, top-k sparsified + 8-bit quantized gossip"
+
+``scale="smoke"`` shrinks model/worker count for CPU runs and CI;
+``scale="full"`` is the reference-sized workload for TPU pods. Data is
+procedurally generated (no network in this environment — see
+consensusml_tpu.data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from consensusml_tpu.compress import topk_int8_compressor
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.data import (
+    SyntheticClassification,
+    SyntheticLM,
+    lm_round_batches,
+    round_batches,
+)
+from consensusml_tpu.topology import topology_from_name
+from consensusml_tpu.train import LocalSGDConfig
+
+__all__ = ["RunBundle", "CONFIGS", "build", "names"]
+
+
+@dataclasses.dataclass
+class RunBundle:
+    """Everything the CLI needs to run one workload."""
+
+    name: str
+    world_size: int
+    cfg: LocalSGDConfig
+    loss_fn: Callable
+    init_params: Callable[[jax.Array], Any]
+    batches: Callable[..., Iterator[dict]]  # (rounds, seed, start=0) -> iterator
+    description: str
+
+
+def _mnist_mlp(scale: str) -> RunBundle:
+    from consensusml_tpu.models import MLP, mlp_loss_fn
+
+    world = 4
+    topo = topology_from_name("dense", world)
+    model = MLP(hidden=256 if scale == "full" else 64)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.adam(1e-3), h=1
+    )
+    data = SyntheticClassification(
+        n=8192 if scale == "full" else 2048, image_shape=(28, 28, 1)
+    )
+    batch = 64
+    return RunBundle(
+        name="mnist_mlp",
+        world_size=world,
+        cfg=cfg,
+        loss_fn=mlp_loss_fn(model),
+        init_params=lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
+        batches=lambda rounds, seed, start=0: round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
+        description="2-layer MLP, 4 workers, dense gossip (CPU reference config)",
+    )
+
+
+def _cifar_resnet50(scale: str) -> RunBundle:
+    from consensusml_tpu.models import resnet50, resnet_init, resnet_loss_fn
+    from consensusml_tpu.models.resnet import BottleneckBlock, ResNet
+
+    world = 8
+    topo = topology_from_name("ring", world)
+    if scale == "full":
+        model = resnet50(num_classes=10, stem="cifar")
+        batch, image = 128, 32
+    else:
+        model = ResNet(
+            stage_sizes=[1, 1], block=BottleneckBlock, num_classes=10, width=8,
+            stem="cifar", dtype=jnp.float32,
+        )
+        batch, image = 8, 16
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo),
+        optimizer=optax.sgd(0.1 if scale == "full" else 0.05, momentum=0.9),
+        h=1,
+    )
+    data = SyntheticClassification(
+        n=4096 if scale == "full" else 512, image_shape=(image, image, 3), noise=0.25
+    )
+    return RunBundle(
+        name="cifar_resnet50",
+        world_size=world,
+        cfg=cfg,
+        loss_fn=resnet_loss_fn(model),
+        init_params=resnet_init(model, (1, image, image, 3)),
+        batches=lambda rounds, seed, start=0: round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
+        description="ResNet-50 (CIFAR stem), 8-worker ring consensus",
+    )
+
+
+def _bert_mlm(scale: str) -> RunBundle:
+    from consensusml_tpu.models.bert import BertConfig, BertMLM, bert_mlm_loss_fn
+
+    if scale == "full":
+        world, model, batch, seq = 32, BertMLM(config=BertConfig()), 32, 128
+        vocab = 30522
+    else:
+        world = 4
+        vocab = 64
+        model = BertMLM(
+            config=BertConfig(
+                vocab_size=vocab, hidden=32, layers=2, heads=2, mlp_dim=64,
+                max_len=32, dropout=0.0,
+            )
+        )
+        batch, seq = 8, 16
+    topo = topology_from_name("ring", world)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.adam(1e-4 if scale == "full" else 1e-2), h=8
+    )
+    data = SyntheticLM(vocab_size=vocab, seq_len=seq)
+    return RunBundle(
+        name="bert_mlm",
+        world_size=world,
+        cfg=cfg,
+        loss_fn=bert_mlm_loss_fn(model),
+        init_params=lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],
+        batches=lambda rounds, seed, start=0: lm_round_batches(
+            data, world, cfg.h, batch, rounds, seed, mlm_rate=0.15, start=start
+        ),
+        description="BERT MLM, local-SGD H=8 + periodic ring averaging",
+    )
+
+
+def _llama_lora(scale: str) -> RunBundle:
+    from consensusml_tpu.models.llama import llama2_7b, llama_tiny, llama_loss_fn
+    from consensusml_tpu.models.lora import lora_gossip_filter, lora_mask, lora_optimizer
+
+    if scale == "full":
+        world, rows, cols = 16, 4, 4
+        model = llama2_7b(lora_rank=16)
+        batch, seq, vocab = 8, 2048, 32000
+    else:
+        world, rows, cols = 4, 2, 2
+        model = llama_tiny(lora_rank=4)
+        batch, seq, vocab = 8, 16, 256
+    topo = topology_from_name("torus", world, rows=rows, cols=cols)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo, path_filter=lora_gossip_filter),
+        optimizer=lora_optimizer(optax.adam(1e-3 if scale == "full" else 1e-2)),
+        h=1,
+    )
+    data = SyntheticLM(vocab_size=vocab, seq_len=seq)
+
+    def init(rng):
+        # shared "pretrained" base across workers (fixed key, not per-worker)
+        base_rng = jax.random.key(42)
+        params = model.init(base_rng, jnp.zeros((1, seq), jnp.int32))["params"]
+        mask = lora_mask(params)
+        leaves = jax.tree.leaves(params)
+        keys = jax.random.split(rng, len(leaves))
+        return jax.tree.unflatten(
+            jax.tree.structure(params),
+            [
+                jax.random.normal(k, p.shape, p.dtype) * 0.02 if m else p
+                for p, m, k in zip(leaves, jax.tree.leaves(mask), keys)
+            ],
+        )
+
+    return RunBundle(
+        name="llama_lora",
+        world_size=world,
+        cfg=cfg,
+        loss_fn=llama_loss_fn(model),
+        init_params=init,
+        batches=lambda rounds, seed, start=0: lm_round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
+        description=f"Llama LoRA fine-tune, {rows}x{cols} torus gossip (adapters-only wire)",
+    )
+
+
+def _gpt2_topk(scale: str) -> RunBundle:
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
+
+    if scale == "full":
+        world = 8
+        model = GPT2LM(config=GPT2Config())  # gpt2-medium dims
+        batch, seq, vocab = 8, 1024, 50257
+    else:
+        world = 4
+        vocab = 64
+        model = GPT2LM(
+            config=GPT2Config(
+                vocab_size=vocab, hidden=32, layers=2, heads=2, max_len=32, dropout=0.0
+            )
+        )
+        batch, seq = 8, 16
+    topo = topology_from_name("ring", world)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(
+            topology=topo,
+            compressor=topk_int8_compressor(ratio=0.01 if scale == "full" else 0.1, chunk=128),
+            gamma=0.5,
+        ),
+        optimizer=optax.adam(1e-4 if scale == "full" else 3e-3),
+        h=2,
+    )
+    data = SyntheticLM(vocab_size=vocab, seq_len=seq)
+    return RunBundle(
+        name="gpt2_topk",
+        world_size=world,
+        cfg=cfg,
+        loss_fn=gpt2_loss_fn(model),
+        init_params=lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],
+        batches=lambda rounds, seed, start=0: lm_round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
+        description="GPT-2 pretrain with top-k + int8 compressed gossip (CHOCO)",
+    )
+
+
+CONFIGS = {
+    "mnist_mlp": _mnist_mlp,
+    "cifar_resnet50": _cifar_resnet50,
+    "bert_mlm": _bert_mlm,
+    "llama_lora": _llama_lora,
+    "gpt2_topk": _gpt2_topk,
+}
+
+
+def names() -> list[str]:
+    return sorted(CONFIGS)
+
+
+def build(name: str, scale: str = "smoke") -> RunBundle:
+    if name not in CONFIGS:
+        raise ValueError(f"unknown config {name!r}; available: {names()}")
+    if scale not in ("smoke", "full"):
+        raise ValueError(f"scale must be smoke|full, got {scale!r}")
+    return CONFIGS[name](scale)

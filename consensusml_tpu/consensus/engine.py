@@ -42,11 +42,24 @@ class ChocoState(NamedTuple):
 
 @dataclasses.dataclass(frozen=True)
 class GossipConfig:
-    """How one consensus round is performed."""
+    """How one consensus round is performed.
+
+    ``path_filter(key_path) -> bool`` restricts gossip to selected leaves —
+    the LoRA pattern: only adapters ride the wire, frozen base weights are
+    passed through untouched (see consensusml_tpu.models.lora).
+    """
 
     topology: Topology
     compressor: Compressor | None = None  # None => exact mixing
     gamma: float = 1.0  # CHOCO consensus step size (ignored when exact)
+    path_filter: Any = None  # Callable[[tuple], bool] | None
+
+    def __post_init__(self):
+        if self.compressor is not None and self.path_filter is not None:
+            raise NotImplementedError(
+                "compressed gossip with a path_filter is not supported yet; "
+                "compress everything or filter exact gossip"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +91,14 @@ class ConsensusEngine:
         """One gossip round, per-worker view. Returns (params, state)."""
         topo = self.topology
         if not self.compressed:
+            flt = self.config.path_filter
+            if flt is not None:
+                return (
+                    jax.tree_util.tree_map_with_path(
+                        lambda p, x: collectives.mix(x, topo) if flt(p) else x, params
+                    ),
+                    None,
+                )
             return collectives.mix_tree(params, topo), None
 
         comp = self.config.compressor
@@ -113,6 +134,15 @@ class ConsensusEngine:
     def round_simulated(self, params: Any, state: ChocoState | None, w: jax.Array):
         """One gossip round on stacked arrays (leading axis = workers)."""
         if not self.compressed:
+            flt = self.config.path_filter
+            if flt is not None:
+                return (
+                    jax.tree_util.tree_map_with_path(
+                        lambda p, x: simulated.mix_stacked(x, w) if flt(p) else x,
+                        params,
+                    ),
+                    None,
+                )
             return simulated.mix_tree_stacked(params, w), None
 
         comp = self.config.compressor

@@ -11,5 +11,7 @@ disjoint shard (the reference's data-parallel partitioning).
 
 from consensusml_tpu.data.synthetic import (  # noqa: F401
     SyntheticClassification,
+    SyntheticLM,
+    lm_round_batches,
     round_batches,
 )

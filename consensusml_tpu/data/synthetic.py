@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SyntheticClassification", "round_batches"]
+__all__ = ["SyntheticClassification", "round_batches", "SyntheticLM", "lm_round_batches"]
 
 
 @dataclasses.dataclass
@@ -53,6 +53,81 @@ class SyntheticClassification:
         }
 
 
+@dataclasses.dataclass
+class SyntheticLM:
+    """Procedural token streams with learnable structure.
+
+    Sequences follow a fixed random Markov chain over the vocab (worker-
+    sharded by seeding), so causal/masked LMs can demonstrably reduce loss
+    without any downloaded corpus.
+    """
+
+    vocab_size: int = 256
+    seq_len: int = 128
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.default_rng(self.seed)
+        # sparse-ish transition table: each token has 4 likely successors.
+        # The last vocab id is RESERVED (never emitted by the chain) so it
+        # can serve as an unambiguous [MASK] token for MLM corruption.
+        succ = rng.integers(0, self.vocab_size - 1, size=(self.vocab_size, 4))
+        self.successors = succ.astype(np.int32)
+
+    @property
+    def mask_token(self) -> int:
+        """Reserved id never produced by the chain."""
+        return self.vocab_size - 1
+
+    def sample(self, rng: np.random.Generator, shape: tuple[int, ...]) -> np.ndarray:
+        """Sample token id sequences of shape (*shape, seq_len)."""
+        n = int(np.prod(shape))
+        out = np.empty((n, self.seq_len), np.int32)
+        state = rng.integers(0, self.vocab_size - 1, size=n)
+        for t in range(self.seq_len):
+            out[:, t] = state
+            choice = rng.integers(0, 4, size=n)
+            state = self.successors[state, choice]
+        return out.reshape(*shape, self.seq_len)
+
+
+def lm_round_batches(
+    dataset: SyntheticLM,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    mlm_rate: float = 0.0,
+    mask_token: int | None = None,
+    start: int = 0,
+):
+    """Stacked (W, H, B, S) LM round batches; ``mlm_rate > 0`` yields
+    BERT-style corrupted inputs + labels + mlm_mask.
+
+    Batches are keyed by (seed, absolute round, rank), so resuming with
+    ``start=N`` continues the EXACT stream a fresh run would have produced
+    at round N (checkpoint/resume correctness)."""
+    for r in range(start, start + rounds):
+        per_worker = []
+        for rank in range(world_size):
+            rng = np.random.default_rng((seed, r, rank))
+            per_worker.append(dataset.sample(rng, (h, batch)))
+        ids = np.stack(per_worker)  # (W, H, B, S)
+        if mlm_rate <= 0:
+            yield {"input_ids": jnp.asarray(ids)}
+        else:
+            rng = np.random.default_rng((seed, r, 10**6))
+            mask = rng.random(ids.shape) < mlm_rate
+            mtok = dataset.mask_token if mask_token is None else mask_token
+            corrupted = np.where(mask, mtok, ids)
+            yield {
+                "input_ids": jnp.asarray(corrupted, jnp.int32),
+                "labels": jnp.asarray(ids, jnp.int32),
+                "mlm_mask": jnp.asarray(mask, jnp.float32),
+            }
+
+
 def round_batches(
     dataset: SyntheticClassification,
     world_size: int,
@@ -60,16 +135,18 @@ def round_batches(
     batch: int,
     rounds: int,
     seed: int = 0,
+    start: int = 0,
 ) -> Iterator[dict[str, jnp.ndarray]]:
     """Yield ``rounds`` stacked round-batches of shape ``(W, H, B, ...)``.
 
     Every worker samples uniformly (with replacement) from its OWN shard —
     workers see disjoint data, which is what makes their replicas drift and
-    gives the consensus step something to do.
+    gives the consensus step something to do. Batches are keyed by
+    (seed, absolute round), so ``start=N`` resumes the exact stream.
     """
     shards = [dataset.worker_shard(r, world_size) for r in range(world_size)]
-    rng = np.random.default_rng(seed)
-    for _ in range(rounds):
+    for rnd in range(start, start + rounds):
+        rng = np.random.default_rng((seed, rnd))
         imgs = np.empty(
             (world_size, h, batch, *dataset.image_shape), np.float32
         )

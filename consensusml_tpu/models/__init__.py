@@ -9,4 +9,14 @@ truth for what exists.
 """
 
 from consensusml_tpu.models.mlp import MLP, mlp_loss_fn  # noqa: F401
-from consensusml_tpu.models.losses import softmax_cross_entropy  # noqa: F401
+from consensusml_tpu.models.losses import (  # noqa: F401
+    masked_lm_loss,
+    softmax_cross_entropy,
+)
+from consensusml_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet50,
+    resnet_init,
+    resnet_loss_fn,
+)

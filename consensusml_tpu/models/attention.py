@@ -1,0 +1,85 @@
+"""Shared attention building blocks for the transformer families.
+
+TPU-first: head dims padded to MXU-friendly sizes by construction, bf16
+QKV matmuls with f32 softmax, optional causal masking via static masks
+(no dynamic shapes), RoPE computed in f32. The long-context path (ring
+attention over a sequence-parallel mesh axis) lives in
+:mod:`consensusml_tpu.parallel.ring_attention` and reuses these blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dot_product_attention", "apply_rope", "rope_frequencies"]
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,  # (B, T, H, D)
+    v: jax.Array,  # (B, T, H, D)
+    *,
+    causal: bool = False,
+    bias: jax.Array | None = None,
+    dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Standard multi-head attention with f32 logits/softmax.
+
+    Logits accumulate in f32 on the MXU (``preferred_element_type``), the
+    softmax runs in f32 for numerical stability, and the output returns to
+    ``dtype`` — the canonical TPU mixed-precision attention recipe.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum(
+        "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        logits = logits + jnp.asarray(bias, jnp.float32)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), jnp.bool_), k=t - s)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhst,bthd->bshd", probs.astype(dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> jax.Array:
+    """Precompute RoPE cos/sin table ``(max_len, head_dim//2, 2)`` in f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # (max_len, head_dim//2)
+    return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)
+
+
+def apply_rope(x: jax.Array, table: jax.Array, positions: jax.Array | None = None) -> jax.Array:
+    """Rotary position embedding. ``x``: (B, S, H, D); table from
+    :func:`rope_frequencies` (at least S rows, or indexed by ``positions``)."""
+    b, s, h, d = x.shape
+    if positions is None:
+        cs = table[:s]  # (S, D/2, 2)
+    else:
+        cs = table[positions]  # (B?, S, D/2, 2) — positions (S,) or (B, S)
+        if cs.ndim == 3:
+            pass
+    cos = cs[..., 0]
+    sin = cs[..., 1]
+    # reshape to pairs
+    xf = jnp.asarray(x, jnp.float32).reshape(b, s, h, d // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    if cos.ndim == 2:  # (S, D/2) -> broadcast over batch and heads
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:  # (B, S, D/2)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    out = jnp.stack([r1, r2], axis=-1).reshape(b, s, h, d)
+    return out.astype(x.dtype)

@@ -1,0 +1,128 @@
+"""BERT encoder for masked-LM pretraining.
+
+Reference parity: "BERT-base MLM, 32-worker local-SGD (H=8) + periodic
+averaging" (BASELINE.json configs[2]; SURVEY.md L5 — mount empty; the
+architecture is canonical Devlin et al. 2018 BERT-base: 12 layers, hidden
+768, 12 heads, GELU, post-LN, learned positions, tied MLM decoder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.models.losses import masked_lm_loss
+
+__all__ = ["BertConfig", "BertMLM", "bert_base", "bert_mlm_loss_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+
+def bert_base(**overrides) -> "BertMLM":
+    return BertMLM(config=BertConfig(**overrides))
+
+
+class _EncoderLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias, deterministic: bool):
+        c = self.config
+        d_head = c.hidden // c.heads
+        qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = dot_product_attention(q, k, v, bias=mask_bias, dtype=c.dtype)
+        attn = nn.DenseGeneral(c.hidden, axis=(-2, -1), dtype=c.dtype, name="out")(attn)
+        attn = nn.Dropout(c.dropout, deterministic=deterministic)(attn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+        y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden, dtype=c.dtype, name="mlp_out")(y)
+        y = nn.Dropout(c.dropout, deterministic=deterministic)(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
+
+
+class BertMLM(nn.Module):
+    """BERT encoder + tied-embedding MLM head.
+
+    ``__call__(input_ids, attention_mask, token_type_ids) -> logits`` over
+    the vocab at every position.
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,  # (B, S) int32
+        attention_mask: jax.Array | None = None,  # (B, S) 1=attend
+        token_type_ids: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        c = self.config
+        b, s = input_ids.shape
+        tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")
+        x = tok_emb(input_ids)
+        pos = jnp.arange(s)[None, :]
+        x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="pos_emb")(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + nn.Embed(c.type_vocab, c.hidden, dtype=c.dtype, name="type_emb")(
+            token_type_ids
+        )
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+
+        if attention_mask is None:
+            bias = None
+        else:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
+        for i in range(c.layers):
+            x = _EncoderLayer(c, name=f"layer_{i}")(x, bias, deterministic)
+
+        # MLM transform head + tied decoder
+        x = nn.Dense(c.hidden, dtype=c.dtype, name="mlm_dense")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
+        logits = tok_emb.attend(jnp.asarray(x, tok_emb.dtype))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros_init(), (c.vocab_size,), jnp.float32
+        )
+        return jnp.asarray(logits, jnp.float32)
+
+
+def bert_mlm_loss_fn(model: BertMLM):
+    """``loss_fn(params, model_state, batch, rng)`` for the trainer.
+
+    batch: ``input_ids`` (corrupted), ``labels`` (original ids),
+    ``mlm_mask`` (1 where the token was masked out and is scored),
+    optional ``attention_mask``.
+    """
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        return masked_lm_loss(logits, batch["labels"], batch["mlm_mask"]), model_state
+
+    return loss_fn

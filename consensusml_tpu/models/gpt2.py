@@ -1,0 +1,99 @@
+"""GPT-2 decoder for causal-LM pretraining.
+
+Reference parity: "GPT-2-medium pretrain, top-k sparsified + 8-bit
+quantized gradient gossip" (BASELINE.json configs[4]; SURVEY.md L5 — mount
+empty; architecture is canonical Radford et al. 2019: pre-LN transformer,
+learned positions, GELU, tied LM head; medium = 24 layers / hidden 1024 /
+16 heads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.models.losses import masked_lm_loss
+
+__all__ = ["GPT2Config", "GPT2LM", "gpt2_medium", "gpt2_loss_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden: int = 1024
+    layers: int = 24
+    heads: int = 16
+    max_len: int = 1024
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def mlp_dim(self) -> int:
+        return 4 * self.hidden
+
+
+def gpt2_medium(**overrides) -> "GPT2LM":
+    return GPT2LM(config=GPT2Config(**overrides))
+
+
+class _DecoderBlock(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        c = self.config
+        d_head = c.hidden // c.heads
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
+        attn = nn.DenseGeneral(c.hidden, axis=(-2, -1), dtype=c.dtype, name="out")(attn)
+        x = x + nn.Dropout(c.dropout, deterministic=deterministic)(attn)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden, dtype=c.dtype, name="mlp_out")(y)
+        return x + nn.Dropout(c.dropout, deterministic=deterministic)(y)
+
+
+class GPT2LM(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+        c = self.config
+        b, s = input_ids.shape
+        tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="wte")
+        x = tok_emb(input_ids)
+        pos = jnp.arange(s)[None, :]
+        x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="wpe")(pos)
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+        for i in range(c.layers):
+            x = _DecoderBlock(c, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = tok_emb.attend(jnp.asarray(x, tok_emb.dtype))
+        return jnp.asarray(logits, jnp.float32)
+
+
+def gpt2_loss_fn(model: GPT2LM):
+    """Next-token prediction: batch has ``input_ids`` (B, S); loss over all
+    positions predicting token t+1 (shift inside)."""
+
+    def loss_fn(params, model_state, batch, rng):
+        ids = batch["input_ids"]
+        logits = model.apply(
+            {"params": params}, ids, deterministic=False, rngs={"dropout": rng}
+        )
+        mask = batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(ids[:, 1:], jnp.float32)
+        else:
+            mask = mask[:, 1:]
+        return masked_lm_loss(logits[:, :-1], ids[:, 1:], mask), model_state
+
+    return loss_fn

@@ -1,0 +1,166 @@
+"""Llama-2 style decoder with built-in LoRA fine-tuning support.
+
+Reference parity: "Llama-2-7B LoRA fine-tune, torus gossip over 4x4 mesh"
+(BASELINE.json configs[3]; SURVEY.md L5 — mount empty; architecture is
+canonical Touvron et al. 2023: RMSNorm pre-norm, RoPE, SwiGLU MLP,
+optional grouped-query attention, untied LM head).
+
+LoRA is a construction-time flag (``lora_rank``): attention projections
+become base-kernel + low-rank ``A @ B`` adapters. Adapter params live at
+paths containing ``lora_``, so :mod:`consensusml_tpu.models.lora` can mask
+the optimizer to adapters only and the gossip engine can exchange ONLY
+adapters (a few MB instead of 7B params — the decentralized-bandwidth win
+that makes the torus-gossip LoRA config practical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.attention import apply_rope, dot_product_attention, rope_frequencies
+from consensusml_tpu.models.losses import masked_lm_loss
+
+__all__ = ["LlamaConfig", "LlamaLM", "llama2_7b", "llama_tiny", "llama_loss_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 32
+    mlp_dim: int = 11008
+    max_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    lora_rank: int = 0  # 0 = plain dense projections
+    lora_alpha: float = 16.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def llama2_7b(**overrides) -> "LlamaLM":
+    return LlamaLM(config=LlamaConfig(**overrides))
+
+
+def llama_tiny(**overrides) -> "LlamaLM":
+    """Test-scale Llama (same code path, tiny dims)."""
+    defaults = dict(
+        vocab_size=256, hidden=64, layers=2, heads=4, kv_heads=2, mlp_dim=128, max_len=128
+    )
+    defaults.update(overrides)
+    return LlamaLM(config=LlamaConfig(**defaults))
+
+
+class LoRADense(nn.Module):
+    """Dense projection with optional low-rank adapter.
+
+    ``y = x @ W  +  (alpha/r) * (x @ A) @ B``; ``A`` is N(0, 1/r)-init,
+    ``B`` zero-init so fine-tuning starts at the base model. Adapter params
+    are named ``lora_a`` / ``lora_b`` for path-based trainable filtering.
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=False, dtype=self.dtype, name="base")(x)
+        if self.rank > 0:
+            a = self.param(
+                "lora_a",
+                nn.initializers.normal(1.0 / self.rank),
+                (x.shape[-1], self.rank),
+                jnp.float32,
+            )
+            b = self.param(
+                "lora_b", nn.initializers.zeros_init(), (self.rank, self.features), jnp.float32
+            )
+            lo = (jnp.asarray(x, self.dtype) @ a.astype(self.dtype)) @ b.astype(self.dtype)
+            y = y + (self.alpha / self.rank) * lo
+        return y
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        xf = jnp.asarray(x, jnp.float32)
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],), jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+class _LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, rope_table):
+        c = self.config
+        d = c.head_dim
+        proj = lambda feats, name: LoRADense(
+            feats, rank=c.lora_rank, alpha=c.lora_alpha, dtype=c.dtype, name=name
+        )
+        y = RMSNorm(c.norm_eps, name="attn_norm")(x)
+        b, s, _ = y.shape
+        q = proj(c.heads * d, "q_proj")(y).reshape(b, s, c.heads, d)
+        k = proj(c.kv_heads * d, "k_proj")(y).reshape(b, s, c.kv_heads, d)
+        v = proj(c.kv_heads * d, "v_proj")(y).reshape(b, s, c.kv_heads, d)
+        q = apply_rope(q, rope_table)
+        k = apply_rope(k, rope_table)
+        if c.kv_heads != c.heads:  # grouped-query attention
+            rep = c.heads // c.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
+        x = x + proj(c.hidden, "o_proj")(attn.reshape(b, s, c.heads * d))
+        y = RMSNorm(c.norm_eps, name="mlp_norm")(x)
+        gate = nn.Dense(c.mlp_dim, use_bias=False, dtype=c.dtype, name="gate_proj")(y)
+        up = nn.Dense(c.mlp_dim, use_bias=False, dtype=c.dtype, name="up_proj")(y)
+        y = nn.Dense(c.hidden, use_bias=False, dtype=c.dtype, name="down_proj")(
+            nn.silu(gate) * up
+        )
+        return x + y
+
+
+class LlamaLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
+        rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
+        for i in range(c.layers):
+            x = _LlamaBlock(c, name=f"layer_{i}")(x, rope_table)
+        x = RMSNorm(c.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
+        return jnp.asarray(logits, jnp.float32)
+
+
+def llama_loss_fn(model: LlamaLM):
+    """Causal next-token loss; batch: ``input_ids`` (+ optional loss_mask)."""
+
+    def loss_fn(params, model_state, batch, rng):
+        ids = batch["input_ids"]
+        logits = model.apply({"params": params}, ids)
+        mask = batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(ids[:, 1:], jnp.float32)
+        else:
+            mask = mask[:, 1:]
+        return masked_lm_loss(logits[:, :-1], ids[:, 1:], mask), model_state
+
+    return loss_fn

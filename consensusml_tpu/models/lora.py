@@ -1,0 +1,78 @@
+"""LoRA fine-tuning utilities: trainable masks and gossip filters.
+
+Reference parity: the PEFT/LoRA capability behind "Llama-2-7B LoRA
+fine-tune" (BASELINE.json configs[3]; SURVEY.md L5 — mount empty). In this
+framework LoRA is a *param-partition*: adapter leaves are identified by
+path (``lora_a`` / ``lora_b`` from
+:class:`consensusml_tpu.models.llama.LoRADense`), the optimizer is masked
+to them, and the gossip engine exchanges only them — base weights stay
+frozen, identical across workers, and off the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+__all__ = ["is_lora_path", "lora_mask", "lora_optimizer", "lora_gossip_filter", "merge_lora"]
+
+
+def is_lora_path(path: tuple) -> bool:
+    """True if a pytree key-path belongs to a LoRA adapter param."""
+    return any(
+        getattr(k, "key", None) in ("lora_a", "lora_b") for k in path
+    )
+
+
+def lora_mask(params: Any) -> Any:
+    """Boolean pytree: True on adapter leaves (for ``optax.masked``)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: is_lora_path(path), params
+    )
+
+
+def lora_optimizer(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Optimizer that updates ONLY adapter leaves; base weights frozen.
+
+    Uses ``multi_transform`` (NOT bare ``optax.masked``, whose unmasked
+    leaves pass raw gradients through as updates — unscaled ascent on the
+    frozen base).
+    """
+
+    def labels(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: "lora" if is_lora_path(path) else "frozen", params
+        )
+
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, labels
+    )
+
+
+def lora_gossip_filter(path: tuple, _leaf: Any = None) -> bool:
+    """Gossip path-filter: exchange adapters only (see
+    :class:`consensusml_tpu.consensus.GossipConfig.path_filter`)."""
+    return is_lora_path(path)
+
+
+def merge_lora(params: Any, alpha_over_rank: float) -> Any:
+    """Fold adapters into base kernels for inference export.
+
+    For every module holding ``{base: {kernel}, lora_a, lora_b}``, returns
+    params with ``kernel += alpha_over_rank * (A @ B)`` and adapters
+    removed. ``alpha_over_rank`` must match the model's ``lora_alpha /
+    lora_rank`` (e.g. 16/4 = 4.0 for the defaults).
+    """
+
+    def merge(node):
+        if not isinstance(node, dict):
+            return node
+        if "lora_a" in node and "lora_b" in node and "base" in node:
+            kernel = node["base"]["kernel"]
+            delta = (node["lora_a"] @ node["lora_b"]) * alpha_over_rank
+            return {"base": {"kernel": kernel + delta.astype(kernel.dtype)}}
+        return {k: merge(v) for k, v in node.items()}
+
+    return merge(params)

@@ -33,14 +33,14 @@ class MLP(nn.Module):
 
 
 def mlp_loss_fn(model: MLP):
-    """``loss_fn(params, batch, rng)`` for the local-SGD trainer.
+    """``loss_fn(params, model_state, batch, rng)`` for the local-SGD trainer.
 
-    ``batch`` is ``{"image": (B, ...), "label": (B,)}``; rng unused (no
-    dropout in the 2-layer MLP).
+    ``batch`` is ``{"image": (B, ...), "label": (B,)}``; rng and
+    model_state unused (no dropout / norm state in the 2-layer MLP).
     """
 
-    def loss_fn(params, batch, rng):
+    def loss_fn(params, model_state, batch, rng):
         logits = model.apply({"params": params}, batch["image"])
-        return softmax_cross_entropy(logits, batch["label"])
+        return softmax_cross_entropy(logits, batch["label"]), model_state
 
     return loss_fn

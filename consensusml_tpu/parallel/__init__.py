@@ -1,0 +1,13 @@
+"""Parallelism beyond gossip-DP: sequence/context parallelism.
+
+The reference's parallelism story is decentralized data-parallel gossip
+(SURVEY.md §2: no TP/PP/SP evidence in BASELINE.json). This package is
+where the TPU build goes further: long-context training via ring
+attention over a sequence-parallel mesh axis (ppermute'd KV blocks with
+online-softmax accumulation), composable with the gossip worker axis on
+the same device mesh.
+"""
+
+from consensusml_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+)

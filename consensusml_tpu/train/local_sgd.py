@@ -1,10 +1,13 @@
 """Local-SGD train step builders for both execution backends.
 
-``loss_fn(params, batch, rng) -> scalar loss`` is user code (a model from
-:mod:`consensusml_tpu.models` or anything else). A *round* consumes a
-batch of shape ``(H, B, ...)`` per worker: H microbatches for the inner
-loop, then one gossip round, then the consensus-error measurement — all in
-one XLA program.
+``loss_fn(params, model_state, batch, rng) -> (scalar loss, new_model_state)``
+is user code (a model from :mod:`consensusml_tpu.models` or anything else);
+``model_state`` carries non-gradient mutables (BatchNorm running stats —
+pass ``{}`` for stateless models). A *round* consumes a batch of shape
+``(H, B, ...)`` per worker: H microbatches for the inner loop, then one
+gossip round (params AND model_state are gossip-averaged jointly, so BN
+statistics reach consensus along with the weights), then the
+consensus-error measurement — all in one XLA program.
 
 Collective backend: per-worker code wrapped in ``shard_map`` over the
 topology's mesh; global arrays carry the mesh's leading worker axes.
@@ -34,12 +37,13 @@ __all__ = [
     "make_simulated_train_step",
 ]
 
-LossFn = Callable[[Any, Any, jax.Array], jax.Array]
+LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, Any]]
 
 
 class TrainState(NamedTuple):
     step: jax.Array  # outer-round counter
     params: Any
+    model_state: Any  # non-gradient mutables (BN stats, ...); {} if none
     opt_state: Any
     gossip: ChocoState | None
     rng: jax.Array
@@ -62,37 +66,63 @@ class LocalSGDConfig:
 # ---------------------------------------------------------------------------
 
 
-def init_state(cfg: LocalSGDConfig, params: Any, rng: jax.Array) -> TrainState:
+def _gossiped(params: Any, model_state: Any) -> dict[str, Any]:
+    """The tree that rides the gossip round: weights + BN-style stats."""
+    return {"params": params, "model_state": model_state}
+
+
+def init_state(cfg: LocalSGDConfig, params: Any, rng: jax.Array, model_state: Any = None) -> TrainState:
     """Per-worker (unstacked) state — used inside the collective backend."""
+    model_state = {} if model_state is None else model_state
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
+        model_state=model_state,
         opt_state=cfg.optimizer.init(params),
-        gossip=cfg.engine().init_state(params),
+        gossip=cfg.engine().init_state(_gossiped(params, model_state)),
         rng=rng,
     )
 
 
 def init_stacked_state(
-    cfg: LocalSGDConfig, init_params: Callable[[jax.Array], Any], rng: jax.Array, world_size: int
+    cfg: LocalSGDConfig,
+    init_params: Callable[[jax.Array], Any],
+    rng: jax.Array,
+    world_size: int,
+    *,
+    with_model_state: bool | None = None,
 ) -> TrainState:
     """Stacked state with per-worker independent inits (simulated backend,
     or host-side construction for the collective backend).
 
-    Each worker gets its own init rng — decentralized training starts from
-    DISAGREEING replicas and consensus pulls them together (that is the
-    point of the consensus-error metric).
+    ``init_params(rng)`` returns either ``params`` or ``(params,
+    model_state)``. By default a length-2 tuple result is treated as the
+    latter; if your *params themselves* are a tuple pytree, pass
+    ``with_model_state=False`` explicitly. Each worker gets its own init
+    rng — decentralized training starts from DISAGREEING replicas and
+    consensus pulls them together (that is the point of the
+    consensus-error metric).
     """
     rngs = jax.random.split(rng, world_size)
-    params = jax.vmap(init_params)(rngs)
+    if with_model_state is None:
+        probe = jax.eval_shape(init_params, rngs[0])
+        has_state = isinstance(probe, tuple) and len(probe) == 2
+    else:
+        has_state = with_model_state
+    if has_state:
+        params, model_state = jax.vmap(init_params)(rngs)
+    else:
+        params = jax.vmap(init_params)(rngs)
+        model_state = {}
     opt_state = jax.vmap(cfg.optimizer.init)(params)
     return TrainState(
         # per-worker step counter so every leaf carries the worker axis
         # (required for sharding under the collective backend)
         step=jnp.zeros((world_size,), jnp.int32),
         params=params,
+        model_state=model_state,
         opt_state=opt_state,
-        gossip=cfg.engine().init_state(params),
+        gossip=cfg.engine().init_state(_gossiped(params, model_state)),
         rng=jax.vmap(jax.random.fold_in, in_axes=(0, None))(rngs, 1),
     )
 
@@ -102,7 +132,9 @@ def init_stacked_state(
 # ---------------------------------------------------------------------------
 
 
-def _inner_loop(cfg: LocalSGDConfig, loss_fn: LossFn, params, opt_state, rng, batch):
+def _inner_loop(
+    cfg: LocalSGDConfig, loss_fn: LossFn, params, model_state, opt_state, rng, batch
+):
     """H local optimizer steps via lax.scan. ``batch`` leaves: (H, ...)."""
     for leaf in jax.tree.leaves(batch):
         if leaf.shape[0] != cfg.h:
@@ -113,17 +145,19 @@ def _inner_loop(cfg: LocalSGDConfig, loss_fn: LossFn, params, opt_state, rng, ba
             )
 
     def body(carry, microbatch):
-        params, opt_state, rng = carry
+        params, model_state, opt_state, rng = carry
         rng, sub = jax.random.split(rng)
-        loss, grads = jax.value_and_grad(loss_fn)(params, microbatch, sub)
+        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, model_state, microbatch, sub
+        )
         updates, opt_state = cfg.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return (params, opt_state, rng), loss
+        return (params, model_state, opt_state, rng), loss
 
-    (params, opt_state, rng), losses = jax.lax.scan(
-        body, (params, opt_state, rng), batch
+    (params, model_state, opt_state, rng), losses = jax.lax.scan(
+        body, (params, model_state, opt_state, rng), batch
     )
-    return params, opt_state, rng, jnp.mean(losses)
+    return params, model_state, opt_state, rng, jnp.mean(losses)
 
 
 # ---------------------------------------------------------------------------
@@ -177,14 +211,18 @@ def make_collective_train_step(
     def sharded_round(state: TrainState, batch: Any):
         state = _squeeze(state, n_axes)
         batch = _squeeze(batch, n_axes)
-        params, opt_state, rng, loss = _inner_loop(
-            cfg, loss_fn, state.params, state.opt_state, state.rng, batch
+        params, model_state, opt_state, rng, loss = _inner_loop(
+            cfg, loss_fn, state.params, state.model_state, state.opt_state, state.rng, batch
         )
-        params, gossip = engine.round_collective(params, state.gossip)
+        mixed, gossip = engine.round_collective(
+            _gossiped(params, model_state), state.gossip
+        )
+        params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_collective(params)
         new_state = TrainState(
             step=state.step + 1,
             params=params,
+            model_state=model_state,
             opt_state=opt_state,
             gossip=gossip,
             rng=rng,
@@ -224,17 +262,21 @@ def make_simulated_train_step(
 
     @jax.jit
     def train_step(state: TrainState, batch: Any):
-        def worker(params, opt_state, rng, batch):
-            return _inner_loop(cfg, loss_fn, params, opt_state, rng, batch)
+        def worker(params, model_state, opt_state, rng, batch):
+            return _inner_loop(cfg, loss_fn, params, model_state, opt_state, rng, batch)
 
-        params, opt_state, rng, losses = jax.vmap(worker)(
-            state.params, state.opt_state, state.rng, batch
+        params, model_state, opt_state, rng, losses = jax.vmap(worker)(
+            state.params, state.model_state, state.opt_state, state.rng, batch
         )
-        params, gossip = engine.round_simulated(params, state.gossip, w)
+        mixed, gossip = engine.round_simulated(
+            _gossiped(params, model_state), state.gossip, w
+        )
+        params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_simulated(params)
         new_state = TrainState(
             step=state.step + 1,
             params=params,
+            model_state=model_state,
             opt_state=opt_state,
             gossip=gossip,
             rng=rng,

@@ -1,0 +1,7 @@
+"""Runtime utilities: checkpointing, metrics logging, tracing."""
+
+from consensusml_tpu.utils.checkpoint import (  # noqa: F401
+    restore_state,
+    save_state,
+)
+from consensusml_tpu.utils.logging import MetricsLogger  # noqa: F401

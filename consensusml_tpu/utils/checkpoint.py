@@ -1,0 +1,41 @@
+"""Checkpoint/resume via orbax (SURVEY.md §5 aux subsystems).
+
+Saves the full stacked TrainState (all workers' replicas + optimizer +
+CHOCO gossip state + per-worker rng), so a decentralized run resumes
+bit-exactly: disagreeing replicas stay disagreeing. The reference's
+per-worker checkpoint files collapse to ONE sharded checkpoint here
+because workers are mesh shards, not processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+__all__ = ["save_state", "restore_state"]
+
+
+def save_state(path: str, state: Any, step: int | None = None) -> str:
+    """Write a checkpoint at ``path`` (optionally ``path/step_N``)."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    return path
+
+
+def restore_state(path: str, like: Any) -> Any:
+    """Restore a checkpoint into the structure/shardings of ``like``."""
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=getattr(x, "sharding", None)),
+            like,
+        )
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(item=like, restore_args=restore_args)
+        )

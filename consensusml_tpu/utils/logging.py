@@ -1,0 +1,45 @@
+"""Metrics logging: stdout lines + machine-readable JSONL.
+
+Covers the reference's metrics/logging subsystem (SURVEY.md §5; mount
+empty). Writes one JSON object per round with wall-clock, loss, and
+consensus-error — the headline pair — plus anything the caller adds.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, IO
+
+__all__ = ["MetricsLogger"]
+
+
+class MetricsLogger:
+    def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout, every: int = 1):
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+        self._stream = stream
+        self._every = max(1, every)
+        self._t0 = time.time()
+
+    def log(self, round_idx: int, metrics: dict[str, Any]) -> None:
+        record = {
+            "round": round_idx,
+            "wall_s": round(time.time() - self._t0, 3),
+            **{k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v)
+               for k, v in metrics.items()},
+        }
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if round_idx % self._every == 0:
+            parts = " ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+                if k != "round"
+            )
+            print(f"[round {round_idx}] {parts}", file=self._stream, flush=True)
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

@@ -1,0 +1,81 @@
+"""CLI entry-point tests (reference L6: train.py / worker.py / --device)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=600):
+    return subprocess.run(
+        [sys.executable, *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+
+
+def test_train_list():
+    r = _run(["train.py", "--list"])
+    assert r.returncode == 0, r.stderr
+    for name in ("mnist_mlp", "cifar_resnet50", "bert_mlm", "llama_lora", "gpt2_topk"):
+        assert name in r.stdout
+
+
+def test_train_requires_config():
+    r = _run(["train.py"])
+    assert r.returncode == 2
+    assert "--config" in r.stderr
+
+
+def test_train_unknown_config():
+    r = _run(["train.py", "--config", "nope", "--device", "cpu"])
+    assert r.returncode != 0
+    assert "unknown config" in r.stderr
+
+
+def test_train_mnist_end_to_end(tmp_path):
+    metrics = tmp_path / "m.jsonl"
+    r = _run(
+        [
+            "train.py", "--config", "mnist_mlp", "--device", "cpu",
+            "--rounds", "5", "--metrics-out", str(metrics),
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final: loss=" in r.stdout
+    lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert len(lines) == 5
+    assert lines[-1]["loss"] < lines[0]["loss"]
+    assert "consensus_error" in lines[0]
+
+
+def test_train_checkpoint_resume(tmp_path):
+    ck = tmp_path / "ckpt"
+    r1 = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu", "--rounds", "3",
+         "--checkpoint-dir", str(ck)]
+    )
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert (ck / "step_3").exists()
+    r2 = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu", "--rounds", "2",
+         "--resume", str(ck / "step_3")]
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "resumed from" in r2.stdout
+
+
+def test_worker_single_process_forwards():
+    r = _run(
+        ["worker.py", "--num-processes", "1", "--",
+         "--config", "mnist_mlp", "--device", "cpu", "--rounds", "2"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final: loss=" in r.stdout

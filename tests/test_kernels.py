@@ -1,0 +1,132 @@
+"""Parity tests: Pallas kernels (interpreter mode) vs jnp reference math.
+
+The Pallas interpreter executes the actual kernel logic (grid, blocks,
+stores) on CPU, so these tests verify the kernels' numerics; the TPU
+compile path is exercised by bench/graft entry on real hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.compress.kernels import (
+    ChunkedTopKCompressor,
+    PallasInt8Compressor,
+    chunked_topk,
+    dequantize_int8,
+    quantize_int8,
+)
+from consensusml_tpu.compress.reference import Int8Compressor
+
+
+@pytest.mark.parametrize("nchunks,chunk", [(4, 128), (32, 256), (33, 128), (1, 512)])
+def test_quantize_kernel_matches_reference(nchunks, chunk):
+    rng = np.random.default_rng(0)
+    chunks = jnp.asarray(rng.normal(size=(nchunks, chunk)) * 3, jnp.float32)
+    q, scales = quantize_int8(chunks, interpret=True)
+    ref = Int8Compressor(chunk=chunk).compress(chunks.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(q).reshape(-1), np.asarray(ref.data))
+    np.testing.assert_allclose(np.asarray(scales), np.asarray(ref.scales), rtol=1e-7)
+
+
+def test_quantize_kernel_zero_rows():
+    chunks = jnp.zeros((8, 128), jnp.float32)
+    q, scales = quantize_int8(chunks, interpret=True)
+    assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scales) == 0)
+
+
+def test_dequantize_kernel_roundtrip():
+    rng = np.random.default_rng(1)
+    chunks = jnp.asarray(rng.normal(size=(16, 256)), jnp.float32)
+    q, scales = quantize_int8(chunks, interpret=True)
+    out = dequantize_int8(q, scales, interpret=True)
+    err = np.abs(np.asarray(out) - np.asarray(chunks))
+    bound = np.asarray(scales)[:, None] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+@pytest.mark.parametrize("nchunks,chunk,k", [(4, 128, 8), (16, 256, 32), (9, 128, 1)])
+def test_chunked_topk_kernel_matches_lax(nchunks, chunk, k):
+    rng = np.random.default_rng(2)
+    chunks = jnp.asarray(rng.normal(size=(nchunks, chunk)), jnp.float32)
+    vals, idx = chunked_topk(chunks, k, interpret=True)
+    _, ref_idx = jax.lax.top_k(jnp.abs(chunks), k)
+    ref_vals = jnp.take_along_axis(chunks, ref_idx, axis=1)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_vals))
+
+
+def test_chunked_topk_tie_breaking():
+    """Equal magnitudes resolve to the lower index, like lax.top_k."""
+    row = jnp.zeros((1, 128), jnp.float32).at[0, 5].set(-3.0).at[0, 9].set(3.0)
+    vals, idx = chunked_topk(row, 2, interpret=True)
+    assert idx.tolist() == [[5, 9]]
+    assert vals.tolist() == [[-3.0, 3.0]]
+
+
+@pytest.mark.parametrize("shape", [(1000,), (37, 53), (8, 128)])
+def test_pallas_int8_codec_parity(shape):
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=shape) * 5, jnp.float32)
+    interp = PallasInt8Compressor(chunk=256, impl="interpret")
+    ref = PallasInt8Compressor(chunk=256, impl="jnp")
+    pi, pr = interp.compress(x), ref.compress(x)
+    np.testing.assert_array_equal(np.asarray(pi.data), np.asarray(pr.data))
+    np.testing.assert_allclose(np.asarray(pi.scales), np.asarray(pr.scales), rtol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(interp.decompress(pi)), np.asarray(ref.decompress(pr)), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("shape", [(1000,), (37, 53), (4, 512)])
+def test_chunked_topk_codec_parity(shape):
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    interp = ChunkedTopKCompressor(chunk=128, k_per_chunk=8, impl="interpret")
+    ref = ChunkedTopKCompressor(chunk=128, k_per_chunk=8, impl="jnp")
+    pi, pr = interp.compress(x), ref.compress(x)
+    np.testing.assert_array_equal(np.asarray(pi.indices), np.asarray(pr.indices))
+    np.testing.assert_allclose(np.asarray(pi.values), np.asarray(pr.values))
+    out = interp.decompress(pi)
+    assert out.shape == shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref.decompress(pr)))
+
+
+def test_chunked_topk_padding_tail_is_safe():
+    """Padded tail beyond n must contribute nothing after decompress."""
+    x = jnp.ones((100,), jnp.float32)  # pads to 128 with zeros
+    codec = ChunkedTopKCompressor(chunk=128, k_per_chunk=128, impl="interpret")
+    out = codec.decompress(codec.compress(x))
+    np.testing.assert_allclose(np.asarray(out), np.ones(100))
+
+
+def test_codec_in_choco_engine():
+    """Pallas codecs drop into the consensus engine (simulated backend)."""
+    from consensusml_tpu.comm import simulated
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+    from consensusml_tpu.topology import RingTopology
+
+    topo = RingTopology(4)
+    engine = ConsensusEngine(
+        GossipConfig(
+            topology=topo,
+            compressor=ChunkedTopKCompressor(chunk=128, k_per_chunk=32, impl="jnp"),
+            gamma=0.5,
+        )
+    )
+    rng = np.random.default_rng(5)
+    x = {"w": jnp.asarray(rng.normal(size=(4, 16, 16)), jnp.float32)}
+    err0 = float(engine.consensus_error_simulated(x))
+    state = engine.init_state(x)
+    w = simulated.mixing_matrix(topo)
+    for _ in range(40):
+        x, state = engine.round_simulated(x, state, w)
+    assert float(engine.consensus_error_simulated(x)) < 0.2 * err0
+
+
+def test_invalid_chunk_rejected():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        PallasInt8Compressor(chunk=100)
+    with pytest.raises(ValueError, match="k_per_chunk"):
+        ChunkedTopKCompressor(chunk=128, k_per_chunk=0)

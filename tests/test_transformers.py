@@ -1,0 +1,200 @@
+"""BERT / GPT-2 / Llama+LoRA at tiny scale: shapes, training smoke runs,
+and the LoRA param-partition (configs[2], [3], [4] of BASELINE.json).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.models.bert import BertConfig, BertMLM, bert_mlm_loss_fn
+from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
+from consensusml_tpu.models.llama import LlamaConfig, llama_tiny, llama_loss_fn
+from consensusml_tpu.models.lora import lora_gossip_filter, lora_mask, lora_optimizer
+from consensusml_tpu.topology import RingTopology, TorusTopology
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    init_stacked_state,
+    make_simulated_train_step,
+)
+
+VOCAB = 64
+
+
+def _tiny_bert():
+    return BertMLM(
+        config=BertConfig(
+            vocab_size=VOCAB, hidden=32, layers=2, heads=2, mlp_dim=64, max_len=32, dropout=0.0
+        )
+    )
+
+
+def _tiny_gpt2():
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=VOCAB, hidden=32, layers=2, heads=2, max_len=32, dropout=0.0
+        )
+    )
+
+
+def _lm_batches(world, h, batch, seq, rounds, seed=0, mlm=False):
+    """Synthetic 'language': next token = (token + 1) % VOCAB — learnable."""
+    rng = np.random.default_rng(seed)
+    for _ in range(rounds):
+        start = rng.integers(0, VOCAB, size=(world, h, batch, 1))
+        ids = (start + np.arange(seq)) % VOCAB
+        out = {"input_ids": jnp.asarray(ids, jnp.int32)}
+        if mlm:
+            mask = rng.random((world, h, batch, seq)) < 0.15
+            corrupted = np.where(mask, VOCAB - 1, ids)
+            out = {
+                "input_ids": jnp.asarray(corrupted, jnp.int32),
+                "labels": jnp.asarray(ids, jnp.int32),
+                "mlm_mask": jnp.asarray(mask, jnp.float32),
+            }
+        yield out
+
+
+def test_bert_shapes():
+    model = _tiny_bert()
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), ids)
+    logits = model.apply(variables, ids)
+    assert logits.shape == (2, 16, VOCAB) and logits.dtype == jnp.float32
+
+
+def test_gpt2_causality():
+    """Changing a future token must not change past logits."""
+    model = _tiny_gpt2()
+    ids = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), ids)
+    a = model.apply(variables, ids)
+    b = model.apply(variables, ids.at[0, 10].set(5))
+    np.testing.assert_allclose(a[0, :10], b[0, :10], atol=1e-5)
+    assert not np.allclose(a[0, 10:], b[0, 10:], atol=1e-5)
+
+
+def test_llama_forward_and_gqa():
+    model = llama_tiny()  # kv_heads=2 < heads=4: exercises GQA
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), ids)
+    logits = model.apply(variables, ids)
+    assert logits.shape == (2, 16, 256)
+    # causality with RoPE
+    a = model.apply(variables, ids)
+    b = model.apply(variables, ids.at[0, 12].set(9))
+    np.testing.assert_allclose(a[0, :12], b[0, :12], atol=1e-4)
+
+
+def test_config3_bert_local_sgd_h8():
+    """BASELINE configs[2] at tiny scale: BERT MLM, local-SGD H=8 ring."""
+    topo = RingTopology(4)
+    model = _tiny_bert()
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.adam(1e-2), h=8
+    )
+    step = make_simulated_train_step(cfg, bert_mlm_loss_fn(model))
+    init = lambda r: model.init(r, jnp.zeros((1, 16), jnp.int32))["params"]
+    state = init_stacked_state(cfg, init, jax.random.key(0), 4)
+    losses = []
+    for batch in _lm_batches(4, h=8, batch=8, seq=16, rounds=40, mlm=True):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < 0.75 * losses[0], f"{losses[0]} -> {losses[-1]}"
+
+
+def test_config5_gpt2_compressed_gossip():
+    """BASELINE configs[4] at tiny scale: GPT-2 with topk+int8 gossip."""
+    from consensusml_tpu.compress import topk_int8_compressor
+
+    topo = RingTopology(4)
+    model = _tiny_gpt2()
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(
+            topology=topo,
+            compressor=topk_int8_compressor(ratio=0.1, chunk=128),
+            gamma=0.5,
+        ),
+        optimizer=optax.adam(3e-3),
+        h=2,
+    )
+    step = make_simulated_train_step(cfg, gpt2_loss_fn(model))
+    init = lambda r: model.init(r, jnp.zeros((1, 16), jnp.int32))["params"]
+    state = init_stacked_state(cfg, init, jax.random.key(1), 4)
+    losses = []
+    for batch in _lm_batches(4, h=2, batch=8, seq=16, rounds=20, seed=3):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < 0.7 * losses[0], f"{losses[0]} -> {losses[-1]}"
+
+
+def test_config4_llama_lora_torus():
+    """BASELINE configs[3] at tiny scale: Llama + LoRA, torus gossip,
+    adapters-only optimization and gossip; base weights stay frozen AND
+    identical across workers."""
+    topo = TorusTopology(2, 2)
+    model = llama_tiny(lora_rank=4)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo, path_filter=lora_gossip_filter),
+        optimizer=lora_optimizer(optax.adam(1e-2)),
+        h=1,
+    )
+    step = make_simulated_train_step(cfg, llama_loss_fn(model))
+
+    base_rng = jax.random.key(42)  # SHARED pretrained base across workers
+
+    def init(rng):
+        params = model.init(base_rng, jnp.zeros((1, 16), jnp.int32))["params"]
+        # re-init adapters per worker so replicas disagree only in LoRA
+        mask = lora_mask(params)
+        leaves = jax.tree.leaves(params)
+        keys = jax.random.split(rng, len(leaves))
+        return jax.tree.unflatten(
+            jax.tree.structure(params),
+            [
+                jax.random.normal(k, p.shape, p.dtype) * 0.05 if m else p
+                for p, m, k in zip(
+                    leaves, jax.tree.leaves(mask), keys
+                )
+            ],
+        )
+
+    state = init_stacked_state(cfg, init, jax.random.key(0), 4)
+    base_before = {
+        "k": np.asarray(
+            state.params["layer_0"]["q_proj"]["base"]["kernel"], np.float32
+        )
+    }
+    losses = []
+    for batch in _lm_batches(4, h=1, batch=8, seq=16, rounds=10, seed=5):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    base_after = np.asarray(
+        state.params["layer_0"]["q_proj"]["base"]["kernel"], np.float32
+    )
+    # frozen base: unchanged by optimizer AND untouched by gossip
+    np.testing.assert_allclose(base_after, base_before["k"], atol=1e-7)
+    # all workers share the same base
+    assert np.allclose(base_after[0], base_after[1])
+    # adapters DID move
+    a0 = np.asarray(state.params["layer_0"]["q_proj"]["lora_a"])
+    assert a0.std() > 0
+
+
+def test_lora_mask_selects_adapters_only():
+    model = llama_tiny(lora_rank=2)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    mask = lora_mask(params)
+    flat = jax.tree_util.tree_leaves_with_path(mask)
+    lora_leaves = [v for p, v in flat if v]
+    non_lora = [v for p, v in flat if not v]
+    assert lora_leaves and non_lora
+    n_lora = sum(
+        1
+        for p, v in jax.tree_util.tree_leaves_with_path(params)
+        if any(getattr(k, "key", None) in ("lora_a", "lora_b") for k in p)
+    )
+    assert len(lora_leaves) == n_lora

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Single-host training entry point.
+
+Reference parity: the reference's ``train.py`` launcher with a
+``--device`` backend flag (BASELINE.json north_star: "existing train.py /
+worker.py entrypoints select the TPU backend via --device=tpu"; SURVEY.md
+L6 — mount empty). Differences born of the TPU design: there is no worker
+process spawn — "N workers" is either N devices in a mesh (``--backend
+collective``) or a stacked axis on one device (``--backend simulated``);
+multi-host pods launch this same script once per host via ``worker.py``.
+
+Examples:
+    python train.py --config mnist_mlp --device cpu --rounds 50
+    python train.py --config gpt2_topk --device cpu --backend simulated
+    python train.py --config cifar_resnet50 --device tpu --scale full
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--config", default=None, help="workload name (see --list)")
+    p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"],
+                   help="backend platform; cpu simulates workers on host devices")
+    p.add_argument("--backend", default="auto", choices=["auto", "collective", "simulated"],
+                   help="collective = shard_map over a device mesh; simulated = "
+                        "stacked workers on one device (CPU reference mode)")
+    p.add_argument("--scale", default=None, choices=["smoke", "full"],
+                   help="workload size (default: smoke on cpu, full on tpu)")
+    p.add_argument("--rounds", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0, help="rounds; 0 = end only")
+    p.add_argument("--resume", default=None, help="checkpoint path to resume from")
+    p.add_argument("--list", action="store_true", help="list configs and exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    # device selection must happen before heavy jax use
+    if args.device == "cpu":
+        os.environ.setdefault("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+            os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=32"
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from consensusml_tpu import configs
+    from consensusml_tpu.comm import WorkerMesh
+    from consensusml_tpu.train import (
+        init_stacked_state,
+        make_collective_train_step,
+        make_simulated_train_step,
+    )
+    from consensusml_tpu.utils import MetricsLogger, restore_state, save_state
+
+    if args.list:
+        for name in configs.names():
+            b = configs.build(name, "smoke")
+            print(f"{name:16s} {b.description}")
+        return 0
+    if args.config is None:
+        print("error: --config is required (or --list)", file=sys.stderr)
+        return 2
+
+    platform = jax.default_backend()
+    scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
+    bundle = configs.build(args.config, scale)
+
+    backend = args.backend
+    if backend == "auto":
+        backend = (
+            "collective"
+            if len(jax.devices()) >= bundle.world_size
+            else "simulated"
+        )
+    print(
+        f"config={bundle.name} scale={scale} platform={platform} "
+        f"backend={backend} workers={bundle.world_size} h={bundle.cfg.h}: "
+        f"{bundle.description}",
+        flush=True,
+    )
+
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(args.seed), bundle.world_size
+    )
+    if backend == "collective":
+        wmesh = WorkerMesh.create(bundle.cfg.gossip.topology)
+        step = make_collective_train_step(bundle.cfg, bundle.loss_fn, wmesh)
+        state = wmesh.shard_stacked(state)
+    else:
+        step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
+
+    start = 0
+    if args.resume:
+        state = restore_state(args.resume, state)
+        import numpy as np
+
+        # per-worker step counters are identical; resume the data stream at
+        # the next absolute round so no batch is replayed
+        start = int(np.asarray(jax.device_get(state.step)).ravel()[0])
+        print(f"resumed from {args.resume} at round {start}", flush=True)
+
+    logger = MetricsLogger(args.metrics_out, every=args.log_every)
+    metrics = {}
+    for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
+        rnd = start + i
+        state, metrics = step(state, batch)
+        logger.log(rnd, metrics)
+        if (
+            args.checkpoint_dir
+            and args.checkpoint_every
+            and (rnd + 1) % args.checkpoint_every == 0
+        ):
+            save_state(args.checkpoint_dir, jax.device_get(state), step=rnd + 1)
+    if args.checkpoint_dir:
+        path = save_state(
+            args.checkpoint_dir, jax.device_get(state), step=start + args.rounds
+        )
+        print(f"checkpoint: {path}", flush=True)
+    logger.close()
+    if metrics:
+        print(
+            f"final: loss={float(metrics['loss']):.4f} "
+            f"consensus_error={float(metrics['consensus_error']):.4f}",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

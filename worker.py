@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Multi-host worker entry point.
+
+Reference parity: the reference's per-rank ``worker.py`` (BASELINE.json
+north_star; SURVEY.md L6 — mount empty). In the reference, one process per
+GPU rendezvouses over NCCL. On TPU pods the unit is the HOST: run this
+script once per host with the same coordinator address; it initializes
+``jax.distributed``, after which ``jax.devices()`` spans the whole pod and
+``train.py``'s collective backend shards the worker mesh across it —
+gossip ppermutes ride ICI between chips and DCN between slices, with no
+explicit rank bootstrap beyond this call.
+
+Example (2 hosts):
+    host0$ python worker.py --coordinator 10.0.0.1:8476 --num-processes 2 \
+               --process-id 0 -- --config cifar_resnet50 --device tpu
+    host1$ python worker.py --coordinator 10.0.0.1:8476 --num-processes 2 \
+               --process-id 1 -- --config cifar_resnet50 --device tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", default=None, help="host:port of process 0")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("train_args", nargs="*", help="arguments forwarded to train.py (after --)")
+    args = p.parse_args(argv)
+
+    if args.num_processes > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        print(
+            f"worker {args.process_id}/{args.num_processes}: "
+            f"global devices={jax.device_count()} local={jax.local_device_count()}",
+            flush=True,
+        )
+
+    import train
+
+    return train.main(args.train_args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
