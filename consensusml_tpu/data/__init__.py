@@ -15,3 +15,7 @@ from consensusml_tpu.data.synthetic import (  # noqa: F401
     lm_round_batches,
     round_batches,
 )
+from consensusml_tpu.data.native_pipeline import (  # noqa: F401
+    native_lm_round_batches,
+    native_round_batches,
+)

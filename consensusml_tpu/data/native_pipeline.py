@@ -1,0 +1,116 @@
+"""Round-batch iterators backed by the native C++ prefetch pipeline.
+
+Reference parity: the reference's native data-loader feeding its training
+loop (SURVEY.md L0/L5; mount empty). Same stacked ``(W, H, B, ...)`` batch
+contract as :mod:`consensusml_tpu.data.synthetic`, but batches are
+synthesized by C++ producer threads that run AHEAD of the training loop —
+round r+1..r+depth-1 are being filled while the TPU executes round r, so
+host data work overlaps device compute instead of serializing with it.
+
+Semantics difference from the Python path (documented, intentional): the
+native stream is an infinite procedural stream (every sample fresh from
+the class-prototype/Markov generative process), whereas the Python path
+draws from a finite per-worker shard. Workers still see disjoint samples
+(disjoint global sample ids), so replicas drift and consensus has work to
+do. The Python path remains the reference semantics used by convergence
+tests; this path is the throughput path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from consensusml_tpu.data.synthetic import SyntheticClassification, SyntheticLM
+
+__all__ = ["native_round_batches", "native_lm_round_batches"]
+
+
+def native_round_batches(
+    dataset: SyntheticClassification,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    depth: int = 4,
+    nthreads: int = 2,
+):
+    """Yield ``rounds`` stacked ``(W, H, B, *image_shape)`` batches.
+
+    Deterministic in ``seed`` (independent of depth/nthreads/timing).
+    """
+    import jax.numpy as jnp
+
+    from consensusml_tpu.native import NativeLoader
+
+    sample_floats = int(np.prod(dataset.image_shape))
+    per_slot = world_size * h * batch
+    with NativeLoader(
+        kind="classification",
+        samples_per_slot=per_slot,
+        sample_floats=sample_floats,
+        sample_ints=1,
+        nclasses_or_vocab=dataset.classes,
+        noise=dataset.noise,
+        prototypes=dataset.prototypes.reshape(dataset.classes, sample_floats),
+        depth=depth,
+        nthreads=nthreads,
+        seed=seed,
+    ) as loader:
+        for _ in range(rounds):
+            floats, ints = loader.next()
+            yield {
+                "image": jnp.asarray(
+                    floats.reshape(world_size, h, batch, *dataset.image_shape)
+                ),
+                "label": jnp.asarray(ints.reshape(world_size, h, batch)),
+            }
+
+
+def native_lm_round_batches(
+    dataset: SyntheticLM,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    mlm_rate: float = 0.0,
+    depth: int = 4,
+    nthreads: int = 2,
+):
+    """Yield stacked ``(W, H, B, S)`` LM batches from the native pipeline.
+
+    ``mlm_rate > 0`` applies BERT-style masking host-side (numpy), keyed by
+    (seed, round) for determinism — corruption is cheap relative to chain
+    sampling, which is what the C++ threads accelerate.
+    """
+    import jax.numpy as jnp
+
+    from consensusml_tpu.native import NativeLoader
+
+    per_slot = world_size * h * batch
+    with NativeLoader(
+        kind="lm",
+        samples_per_slot=per_slot,
+        sample_floats=0,
+        sample_ints=dataset.seq_len,
+        nclasses_or_vocab=dataset.vocab_size,
+        successors=dataset.successors,
+        depth=depth,
+        nthreads=nthreads,
+        seed=seed,
+    ) as loader:
+        for r in range(rounds):
+            _, ints = loader.next()
+            ids = ints.reshape(world_size, h, batch, dataset.seq_len)
+            if mlm_rate <= 0:
+                yield {"input_ids": jnp.asarray(ids)}
+            else:
+                rng = np.random.default_rng((seed, r, 10**6))
+                mask = rng.random(ids.shape) < mlm_rate
+                corrupted = np.where(mask, dataset.mask_token, ids)
+                yield {
+                    "input_ids": jnp.asarray(corrupted, jnp.int32),
+                    "labels": jnp.asarray(ids, jnp.int32),
+                    "mlm_mask": jnp.asarray(mask, jnp.float32),
+                }

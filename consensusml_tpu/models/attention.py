@@ -66,8 +66,6 @@ def apply_rope(x: jax.Array, table: jax.Array, positions: jax.Array | None = Non
         cs = table[:s]  # (S, D/2, 2)
     else:
         cs = table[positions]  # (B?, S, D/2, 2) — positions (S,) or (B, S)
-        if cs.ndim == 3:
-            pass
     cos = cs[..., 0]
     sin = cs[..., 1]
     # reshape to pairs

@@ -1,0 +1,258 @@
+"""ctypes bindings for the native C++ runtime (native/).
+
+Reference parity: the reference's native (CUDA/C++) runtime layer —
+data-loading/prefetch and compression kernels (SURVEY.md L0; BASELINE.json
+north_star names the CUDA compression kernels; mount empty so the design
+is original). The TPU compute path stays JAX/Pallas; this layer is the
+HOST runtime around it: threaded batch prefetch that overlaps with device
+compute, and CPU kernels used as an independent parity check on the
+jnp/Pallas codecs and for host-side payload work.
+
+The library is built lazily with ``make -C native`` on first use (g++ is
+part of the toolchain). If the build fails, ``available()`` returns False
+and callers fall back to the pure-Python paths — nothing in the framework
+*requires* the native layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = [
+    "available",
+    "quantize_int8_chunks",
+    "dequantize_int8_chunks",
+    "topk",
+    "topk_chunks",
+    "NativeLoader",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libcml_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed: str | None = None
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i8p = ctypes.POINTER(ctypes.c_int8)
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.SubprocessError) as e:
+            _load_failed = f"{type(e).__name__}: {e}"
+            return None
+        lib.cml_quant_int8.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _i8p, _f32p]
+        lib.cml_dequant_int8.argtypes = [_i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p]
+        lib.cml_topk.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p]
+        lib.cml_topk_chunks.argtypes = [
+            _f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p,
+        ]
+        lib.cml_loader_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_float, _f32p, _i32p,
+        ]
+        lib.cml_loader_create.restype = ctypes.c_void_p
+        lib.cml_loader_acquire.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_f32p), ctypes.POINTER(_i32p),
+        ]
+        lib.cml_loader_acquire.restype = ctypes.c_int
+        lib.cml_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.cml_loader_produced.argtypes = [ctypes.c_void_p]
+        lib.cml_loader_produced.restype = ctypes.c_uint64
+        lib.cml_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is loadable (builds it if needed)."""
+    return _load() is not None
+
+
+def _as_f32(x) -> np.ndarray:
+    return np.ascontiguousarray(x, dtype=np.float32)
+
+
+def quantize_int8_chunks(chunks) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize ``(nchunks, chunk)`` f32 rows -> (int8 rows, f32 scales).
+
+    Same semantics as compress.reference.Int8Compressor per-chunk math.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_failed}")
+    chunks = _as_f32(chunks)
+    nchunks, chunk = chunks.shape
+    q = np.empty((nchunks, chunk), np.int8)
+    scales = np.empty((nchunks,), np.float32)
+    lib.cml_quant_int8(
+        chunks.ctypes.data_as(_f32p), nchunks, chunk,
+        q.ctypes.data_as(_i8p), scales.ctypes.data_as(_f32p),
+    )
+    return q, scales
+
+
+def dequantize_int8_chunks(q, scales) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_failed}")
+    q = np.ascontiguousarray(q, dtype=np.int8)
+    scales = _as_f32(scales)
+    nchunks, chunk = q.shape
+    out = np.empty((nchunks, chunk), np.float32)
+    lib.cml_dequant_int8(
+        q.ctypes.data_as(_i8p), scales.ctypes.data_as(_f32p), nchunks, chunk,
+        out.ctypes.data_as(_f32p),
+    )
+    return out
+
+
+def topk(x, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """k largest by magnitude: (values, indices), jax.lax.top_k ordering."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_failed}")
+    x = _as_f32(x).reshape(-1)
+    k = min(k, x.size)
+    vals = np.empty((k,), np.float32)
+    idx = np.empty((k,), np.int32)
+    lib.cml_topk(x.ctypes.data_as(_f32p), x.size, k,
+                 vals.ctypes.data_as(_f32p), idx.ctypes.data_as(_i32p))
+    return vals, idx
+
+
+def topk_chunks(chunks, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row top-k over ``(nchunks, chunk)``: (values, local indices)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_failed}")
+    chunks = _as_f32(chunks)
+    nchunks, chunk = chunks.shape
+    k = min(k, chunk)
+    vals = np.empty((nchunks, k), np.float32)
+    idx = np.empty((nchunks, k), np.int32)
+    lib.cml_topk_chunks(
+        chunks.ctypes.data_as(_f32p), nchunks, chunk, k,
+        vals.ctypes.data_as(_f32p), idx.ctypes.data_as(_i32p),
+    )
+    return vals, idx
+
+
+class NativeLoader:
+    """Threaded prefetching batch pipeline over the native ring buffer.
+
+    One acquired slot = one "round batch" of ``samples_per_slot`` samples;
+    the caller reshapes (see data.native_pipeline). Deterministic: slot
+    ``i`` of a loader with seed ``s`` has identical bytes regardless of
+    ``nthreads``/``depth``/timing.
+    """
+
+    def __init__(
+        self,
+        *,
+        kind: str,  # "classification" | "lm"
+        samples_per_slot: int,
+        sample_floats: int,
+        sample_ints: int,
+        nclasses_or_vocab: int,
+        noise: float = 0.0,
+        prototypes: np.ndarray | None = None,
+        successors: np.ndarray | None = None,
+        depth: int = 4,
+        nthreads: int = 2,
+        seed: int = 0,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_failed}")
+        self._lib = lib
+        self._shape_f = (samples_per_slot, sample_floats)
+        self._shape_i = (samples_per_slot, sample_ints)
+        kinds = {"classification": 0, "lm": 1}
+        if kind not in kinds:
+            raise ValueError(f"unknown kind {kind!r}")
+        proto_p = None
+        succ_p = None
+        if prototypes is not None:
+            self._proto = _as_f32(prototypes).reshape(nclasses_or_vocab, sample_floats)
+            proto_p = self._proto.ctypes.data_as(_f32p)
+        if successors is not None:
+            self._succ = np.ascontiguousarray(successors, np.int32).reshape(
+                nclasses_or_vocab, 4
+            )
+            succ_p = self._succ.ctypes.data_as(_i32p)
+        if kind == "lm" and succ_p is None:
+            raise ValueError("lm kind requires a successors table")
+        self._h = lib.cml_loader_create(
+            depth, nthreads, seed, kinds[kind],
+            samples_per_slot, sample_floats, sample_ints,
+            nclasses_or_vocab, noise, proto_p, succ_p,
+        )
+        if not self._h:
+            raise RuntimeError("cml_loader_create failed (bad arguments)")
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        """Blocking: copies of the next slot's (floats, ints) arrays."""
+        fptr = _f32p()
+        iptr = _i32p()
+        idx = self._lib.cml_loader_acquire(self._h, ctypes.byref(fptr), ctypes.byref(iptr))
+        if idx < 0:
+            raise RuntimeError("loader stopped")
+        def _copy(ptr, shape, dtype):
+            if 0 in shape:  # empty buffer: C++ data() may be NULL
+                return np.empty(shape, dtype)
+            return np.ctypeslib.as_array(ptr, shape=shape).copy()
+
+        try:
+            floats = _copy(fptr, self._shape_f, np.float32)
+            ints = _copy(iptr, self._shape_i, np.int32)
+        finally:
+            self._lib.cml_loader_release(self._h, idx)
+        return floats, ints
+
+    def produced(self) -> int:
+        return int(self._lib.cml_loader_produced(self._h))
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.cml_loader_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

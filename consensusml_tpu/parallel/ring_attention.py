@@ -24,6 +24,13 @@ __all__ = ["ring_attention"]
 _NEG_INF = -1e30
 
 
+def _pvary(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark ``x`` as device-varying along ``axis_name`` (VMA annotation)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)
+
+
 def ring_attention(
     q: jax.Array,  # (B, S_blk, H, D) — this device's query block
     k: jax.Array,  # (B, S_blk, H, D)
@@ -78,9 +85,9 @@ def ring_attention(
 
     # initial accumulators must carry the device-varying axis annotation
     # (VMA) or the fori_loop carry types mismatch after the first ppermute
-    out0 = jax.lax.pvary(jnp.zeros((b, s_blk, h, d), jnp.float32), axis_name)
-    max0 = jax.lax.pvary(jnp.full((b, h, s_blk), _NEG_INF, jnp.float32), axis_name)
-    sum0 = jax.lax.pvary(jnp.zeros((b, h, s_blk), jnp.float32), axis_name)
+    out0 = _pvary(jnp.zeros((b, s_blk, h, d), jnp.float32), axis_name)
+    max0 = _pvary(jnp.full((b, h, s_blk), _NEG_INF, jnp.float32), axis_name)
+    sum0 = _pvary(jnp.zeros((b, h, s_blk), jnp.float32), axis_name)
     out, _, row_sum, _ = jax.lax.fori_loop(0, p, step, (out0, max0, sum0, (k, v)))
     denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
     return (out / denom).astype(q.dtype)

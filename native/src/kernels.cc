@@ -1,0 +1,112 @@
+// CPU compression kernels: per-chunk int8 quantization and magnitude
+// top-k selection.
+//
+// Reference parity: the reference's CUDA gradient-compression / top-k
+// sparsification kernels (BASELINE.json north_star; SURVEY.md L0 — mount
+// empty). On TPU the hot path is the Pallas implementation
+// (consensusml_tpu/compress/kernels.py); these native kernels are the
+// HOST-side leg — an independent third implementation used for
+// cross-checking the jnp/Pallas semantics and for host-side work
+// (checkpoint compression, DCN payload prep) where no accelerator is in
+// the loop.
+//
+// Numerical semantics are pinned to consensusml_tpu/compress/reference.py:
+//   quant:  scale = absmax/127; q = clip(round_nearest_even(x/scale));
+//           zero chunks -> scale 0, decode to exact zeros.
+//   top-k:  k largest by |x|, descending, ties broken by lower index
+//           (jax.lax.top_k ordering).
+
+#include <algorithm>
+#include <cfenv>
+#include <cmath>
+#include <cstdint>
+#include <numeric>
+#include <thread>
+#include <vector>
+
+namespace cml {
+
+// Run fn(chunk_index) over [0, nchunks) on up to hardware_concurrency threads.
+template <typename Fn>
+static void ParallelFor(int64_t nchunks, Fn fn) {
+  const int64_t hw = (int64_t)std::thread::hardware_concurrency();
+  const int64_t nthreads = std::max<int64_t>(1, std::min<int64_t>(hw, nchunks));
+  if (nthreads == 1) {
+    for (int64_t c = 0; c < nchunks; ++c) fn(c);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads);
+  const int64_t per = (nchunks + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min(nchunks, lo + per);
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &fn] {
+      for (int64_t c = lo; c < hi; ++c) fn(c);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace cml
+
+extern "C" {
+
+// q: [nchunks, chunk] int8, scales: [nchunks] f32
+void cml_quant_int8(const float* x, int64_t nchunks, int64_t chunk, int8_t* q,
+                    float* scales) {
+  cml::ParallelFor(nchunks, [&](int64_t c) {
+    const float* row = x + c * chunk;
+    int8_t* qrow = q + c * chunk;
+    float absmax = 0.0f;
+    for (int64_t j = 0; j < chunk; ++j) absmax = std::max(absmax, std::fabs(row[j]));
+    const float scale = absmax / 127.0f;
+    scales[c] = scale;
+    const float inv = scale > 0.0f ? 1.0f / scale : 0.0f;
+    for (int64_t j = 0; j < chunk; ++j) {
+      // nearbyintf under the default FP environment = round-to-nearest-even,
+      // matching jnp.rint
+      float r = std::nearbyintf(row[j] * inv);
+      r = std::min(127.0f, std::max(-127.0f, r));
+      qrow[j] = (int8_t)r;
+    }
+  });
+}
+
+void cml_dequant_int8(const int8_t* q, const float* scales, int64_t nchunks,
+                      int64_t chunk, float* out) {
+  cml::ParallelFor(nchunks, [&](int64_t c) {
+    const float scale = scales[c];
+    const int8_t* qrow = q + c * chunk;
+    float* row = out + c * chunk;
+    for (int64_t j = 0; j < chunk; ++j) row[j] = (float)qrow[j] * scale;
+  });
+}
+
+// vals/idx: [k]; k largest by |x|, descending magnitude, ties -> lower index.
+void cml_topk(const float* x, int64_t n, int64_t k, float* vals, int32_t* idx) {
+  if (k > n) k = n;
+  std::vector<int32_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  const auto cmp = [x](int32_t a, int32_t b) {
+    const float fa = std::fabs(x[a]), fb = std::fabs(x[b]);
+    if (fa != fb) return fa > fb;
+    return a < b;
+  };
+  std::partial_sort(order.begin(), order.begin() + k, order.end(), cmp);
+  for (int64_t i = 0; i < k; ++i) {
+    idx[i] = order[i];
+    vals[i] = x[order[i]];
+  }
+}
+
+// Per-chunk top-k: vals/idx are [nchunks, k]; indices are LOCAL to the chunk.
+void cml_topk_chunks(const float* x, int64_t nchunks, int64_t chunk, int64_t k,
+                     float* vals, int32_t* idx) {
+  cml::ParallelFor(nchunks, [&](int64_t c) {
+    cml_topk(x + c * chunk, chunk, k, vals + c * k, idx + c * k);
+  });
+}
+
+}  // extern "C"

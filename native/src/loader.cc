@@ -1,0 +1,225 @@
+// Threaded prefetching data pipeline: a ring of pre-allocated host slots
+// filled by producer threads, consumed in sequence order by Python.
+//
+// Reference parity: the reference framework's native data-loader /
+// prefetcher (SURVEY.md L0 "native components the TPU build must
+// re-implement"; reference mount empty, so this is the standard
+// producer-consumer ring design, not a translation). TPU fit: the consumer
+// overlaps host-side batch synthesis with device compute — while the TPU
+// runs round r, threads are already filling rounds r+1..r+depth-1.
+//
+// Determinism: slot contents are a pure function of (seed, sequence
+// number) — producer threads claim sequence numbers atomically but the
+// bytes they write never depend on which thread ran. Consumers always
+// receive slots in sequence order.
+//
+// Slot layout: [samples_per_slot * sample_floats] f32, then
+//              [samples_per_slot * sample_ints] i32.
+//
+// Generation kinds:
+//   0 = classification: label ~ U(nclasses); image = prototypes[label]
+//       + noise * N(0,1)   (prototype table supplied by Python)
+//   1 = Markov LM: token chain over a [vocab, 4] successor table; emitted
+//       states are in [0, vocab-1) so vocab-1 can serve as [MASK].
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "rng.h"
+
+namespace cml {
+
+enum class SlotState : int { kFree = 0, kFilling = 1, kReady = 2, kInUse = 3 };
+
+struct Slot {
+  std::vector<float> floats;
+  std::vector<int32_t> ints;
+  SlotState state = SlotState::kFree;
+  uint64_t seq = 0;  // valid when kReady/kInUse
+};
+
+class Loader {
+ public:
+  Loader(int depth, int nthreads, uint64_t seed, int kind,
+         int64_t samples_per_slot, int64_t sample_floats, int64_t sample_ints,
+         int32_t nclasses_or_vocab, float noise, const float* prototypes,
+         const int32_t* successors)
+      : depth_(depth),
+        seed_(seed),
+        kind_(kind),
+        samples_per_slot_(samples_per_slot),
+        sample_floats_(sample_floats),
+        sample_ints_(sample_ints),
+        nclasses_(nclasses_or_vocab),
+        noise_(noise) {
+    if (prototypes != nullptr && kind == 0) {
+      prototypes_.assign(prototypes,
+                         prototypes + (int64_t)nclasses_ * sample_floats_);
+    }
+    if (successors != nullptr && kind == 1) {
+      successors_.assign(successors, successors + (int64_t)nclasses_ * 4);
+    }
+    slots_.resize(depth_);
+    for (auto& s : slots_) {
+      s.floats.resize(samples_per_slot_ * sample_floats_);
+      s.ints.resize(samples_per_slot_ * sample_ints_);
+    }
+    for (int t = 0; t < nthreads; ++t) {
+      threads_.emplace_back([this] { ProducerLoop(); });
+    }
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_producer_.notify_all();
+    cv_consumer_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  // Blocks until the next-in-order slot is ready; returns its index and
+  // exposes its buffers. Returns -1 only after Stop() (not used today).
+  int Acquire(float** fptr, int32_t** iptr) {
+    std::unique_lock<std::mutex> lk(mu_);
+    const uint64_t want = next_consume_;
+    Slot& slot = slots_[want % depth_];
+    cv_consumer_.wait(lk, [&] {
+      return stop_ || (slot.state == SlotState::kReady && slot.seq == want);
+    });
+    if (stop_) return -1;
+    slot.state = SlotState::kInUse;
+    next_consume_++;
+    *fptr = slot.floats.data();
+    *iptr = slot.ints.data();
+    return (int)(want % depth_);
+  }
+
+  void Release(int idx) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      slots_[idx].state = SlotState::kFree;
+    }
+    cv_producer_.notify_all();
+  }
+
+  uint64_t Produced() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return next_produce_;
+  }
+
+ private:
+  void ProducerLoop() {
+    for (;;) {
+      uint64_t seq;
+      Slot* slot;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_producer_.wait(lk, [&] {
+          if (stop_) return true;
+          // the slot for the next unclaimed seq must be free
+          return slots_[next_produce_ % depth_].state == SlotState::kFree;
+        });
+        if (stop_) return;
+        seq = next_produce_++;
+        slot = &slots_[seq % depth_];
+        slot->state = SlotState::kFilling;
+      }
+      Fill(*slot, seq);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        slot->state = SlotState::kReady;
+        slot->seq = seq;
+      }
+      cv_consumer_.notify_all();
+      cv_producer_.notify_all();
+    }
+  }
+
+  void Fill(Slot& slot, uint64_t seq) {
+    for (int64_t i = 0; i < samples_per_slot_; ++i) {
+      const uint64_t gid = seq * (uint64_t)samples_per_slot_ + (uint64_t)i;
+      Rng rng(splitmix64(seed_ ^ (gid * 0x9E3779B97F4A7C15ULL + 0x5DEECE66DULL)));
+      if (kind_ == 0) {
+        const int32_t label = (int32_t)rng.randint((uint32_t)nclasses_);
+        float* img = slot.floats.data() + i * sample_floats_;
+        const float* proto =
+            prototypes_.empty() ? nullptr
+                                : prototypes_.data() + (int64_t)label * sample_floats_;
+        for (int64_t j = 0; j < sample_floats_; ++j) {
+          img[j] = (proto != nullptr ? proto[j] : 0.0f) + noise_ * rng.gauss();
+        }
+        for (int64_t j = 0; j < sample_ints_; ++j) {
+          slot.ints[i * sample_ints_ + j] = label;
+        }
+      } else {  // Markov LM
+        int32_t state = (int32_t)rng.randint((uint32_t)(nclasses_ - 1));
+        int32_t* toks = slot.ints.data() + i * sample_ints_;
+        for (int64_t t = 0; t < sample_ints_; ++t) {
+          toks[t] = state;
+          state = successors_[(int64_t)state * 4 + rng.randint(4)];
+        }
+      }
+    }
+  }
+
+  const int depth_;
+  const uint64_t seed_;
+  const int kind_;
+  const int64_t samples_per_slot_;
+  const int64_t sample_floats_;
+  const int64_t sample_ints_;
+  const int32_t nclasses_;
+  const float noise_;
+  std::vector<float> prototypes_;
+  std::vector<int32_t> successors_;
+
+  std::mutex mu_;
+  std::condition_variable cv_producer_;
+  std::condition_variable cv_consumer_;
+  std::vector<Slot> slots_;
+  std::vector<std::thread> threads_;
+  uint64_t next_produce_ = 0;
+  uint64_t next_consume_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace cml
+
+extern "C" {
+
+void* cml_loader_create(int depth, int nthreads, uint64_t seed, int kind,
+                        int64_t samples_per_slot, int64_t sample_floats,
+                        int64_t sample_ints, int32_t nclasses_or_vocab,
+                        float noise, const float* prototypes,
+                        const int32_t* successors) {
+  if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
+  if (kind != 0 && kind != 1) return nullptr;
+  if (kind == 1 && (successors == nullptr || nclasses_or_vocab < 2)) return nullptr;
+  if (nclasses_or_vocab < 1) return nullptr;
+  return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
+                         sample_floats, sample_ints, nclasses_or_vocab, noise,
+                         prototypes, successors);
+}
+
+int cml_loader_acquire(void* h, float** fptr, int32_t** iptr) {
+  return static_cast<cml::Loader*>(h)->Acquire(fptr, iptr);
+}
+
+void cml_loader_release(void* h, int idx) {
+  static_cast<cml::Loader*>(h)->Release(idx);
+}
+
+uint64_t cml_loader_produced(void* h) {
+  return static_cast<cml::Loader*>(h)->Produced();
+}
+
+void cml_loader_destroy(void* h) { delete static_cast<cml::Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+// Deterministic counter-keyed RNG for the native data pipeline.
+//
+// Reference parity: the reference's native data-loader layer (SURVEY.md L0
+// native components; reference mount empty — see SURVEY.md blocker). Every
+// sample's bytes are a pure function of (seed, global sample id), so the
+// pipeline is reproducible regardless of thread count or scheduling — the
+// property the tests pin down.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+
+namespace cml {
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// xorshift128+ seeded via splitmix64 (never all-zero state).
+struct Rng {
+  uint64_t s0, s1;
+
+  explicit Rng(uint64_t seed) {
+    s0 = splitmix64(seed);
+    s1 = splitmix64(s0 ^ 0x6A09E667F3BCC909ULL);
+    if (s0 == 0 && s1 == 0) s1 = 1;
+  }
+
+  inline uint64_t next() {
+    uint64_t x = s0;
+    const uint64_t y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+
+  // uniform in [0, 1) with 24 bits of mantissa entropy
+  inline float uniform() { return (float)(next() >> 40) * (1.0f / 16777216.0f); }
+
+  inline uint32_t randint(uint32_t n) { return (uint32_t)(next() % n); }
+
+  // standard normal via Box-Muller (cosine branch)
+  inline float gauss() {
+    float u1 = uniform();
+    const float u2 = uniform();
+    if (u1 < 1e-7f) u1 = 1e-7f;
+    return sqrtf(-2.0f * logf(u1)) * cosf(6.28318530717958647692f * u2);
+  }
+};
+
+}  // namespace cml

@@ -55,6 +55,13 @@ def main(argv=None) -> int:
 
     if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() not in ("tpu", "axon"):
+        print(
+            f"error: --device tpu requested but jax backend is "
+            f"{jax.default_backend()!r} (no TPU reachable)",
+            file=sys.stderr,
+        )
+        return 2
 
     from consensusml_tpu import configs
     from consensusml_tpu.comm import WorkerMesh
@@ -114,6 +121,7 @@ def main(argv=None) -> int:
 
     logger = MetricsLogger(args.metrics_out, every=args.log_every)
     metrics = {}
+    last_saved = None
     for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
         rnd = start + i
         state, metrics = step(state, batch)
@@ -124,7 +132,8 @@ def main(argv=None) -> int:
             and (rnd + 1) % args.checkpoint_every == 0
         ):
             save_state(args.checkpoint_dir, jax.device_get(state), step=rnd + 1)
-    if args.checkpoint_dir:
+            last_saved = rnd + 1
+    if args.checkpoint_dir and last_saved != start + args.rounds:
         path = save_state(
             args.checkpoint_dir, jax.device_get(state), step=start + args.rounds
         )
