@@ -54,10 +54,22 @@ class WorkerMesh:
     — e.g. ``(W, ...)`` for a ring, ``(R, C, ...)`` for a torus — sharded
     one-slice-per-device via :meth:`worker_spec`. Inside ``shard_map`` each
     worker sees its slice with singleton leading axes.
+
+    ``model_axes`` generalizes a worker from one device to a SUBMESH: the
+    mesh becomes ``(*topology.mesh_shape, *model_axis_sizes)``. Gossip
+    collectives stay manual over the worker axes (``shard_map``
+    partial-manual mode) while the model axes remain in XLA *auto*
+    sharding mode — annotate params with
+    :mod:`consensusml_tpu.parallel.sharding` rules and the compiler
+    inserts the intra-worker tensor-parallel collectives. This is how the
+    Llama-2-7B torus config runs full-weights on a pod: 4x4 workers x
+    tp-submesh each, something the reference's one-process-per-GPU design
+    cannot express (SURVEY.md §2: no TP/PP evidence in the reference).
     """
 
     topology: Topology
     mesh: Mesh
+    model_axes: tuple[tuple[str, int], ...] = ()
 
     @classmethod
     def create(
@@ -65,19 +77,33 @@ class WorkerMesh:
         topology: Topology,
         devices: Sequence[jax.Device] | None = None,
         platform: str | None = None,
+        model_axes: Sequence[tuple[str, int]] = (),
     ) -> "WorkerMesh":
+        model_axes = tuple((str(n), int(s)) for n, s in model_axes)
+        if overlap := {n for n, _ in model_axes} & set(topology.axis_names):
+            raise ValueError(f"model axes {sorted(overlap)} collide with worker axes")
+        per_worker = int(np.prod([s for _, s in model_axes])) if model_axes else 1
+        need = topology.world_size * per_worker
         if devices is None:
-            devices = local_device_mesh(topology.world_size, platform)
-        if len(devices) != topology.world_size:
+            devices = local_device_mesh(need, platform)
+        if len(devices) != need:
             raise ValueError(
-                f"topology wants {topology.world_size} devices, got {len(devices)}"
+                f"topology wants {topology.world_size} workers x {per_worker} "
+                f"devices/worker = {need} devices, got {len(devices)}"
             )
-        dev_array = np.asarray(devices, dtype=object).reshape(topology.mesh_shape)
-        return cls(topology=topology, mesh=Mesh(dev_array, topology.axis_names))
+        shape = (*topology.mesh_shape, *(s for _, s in model_axes))
+        names = (*topology.axis_names, *(n for n, _ in model_axes))
+        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+        return cls(topology=topology, mesh=Mesh(dev_array, names), model_axes=model_axes)
 
     @property
     def axis_names(self) -> tuple[str, ...]:
         return self.topology.axis_names
+
+    def manual_axes(self) -> frozenset[str] | None:
+        """Axes ``shard_map`` should be manual over: the worker axes when a
+        model submesh exists (partial-manual), else None (fully manual)."""
+        return frozenset(self.axis_names) if self.model_axes else None
 
     def worker_spec(self) -> PartitionSpec:
         """PartitionSpec sharding the leading worker axes over the mesh."""
@@ -91,17 +117,26 @@ class WorkerMesh:
 
     def stacked_sharding(self) -> NamedSharding:
         """Sharding for FLAT-stacked arrays ``(W, ...)``: the single leading
-        axis is split over ALL mesh axes (row-major), so a later reshape to
-        ``mesh_shape`` leading axes is layout-preserving."""
+        axis is split over the WORKER mesh axes (row-major), so a later
+        reshape to ``mesh_shape`` leading axes is layout-preserving.
+        Trailing dims are replicated (over any model axes too) — use
+        :meth:`stacked_shardings` with rules to also split model dims."""
         return NamedSharding(self.mesh, PartitionSpec(self.axis_names))
 
-    def shard_stacked(self, tree):
+    def stacked_shardings(self, tree, rules=None):
+        """Per-leaf NamedSharding tree for flat-stacked arrays: leading axis
+        over the worker axes, trailing dims per the model-sharding
+        ``rules`` (see :mod:`consensusml_tpu.parallel.sharding`)."""
+        from consensusml_tpu.parallel import sharding as _sharding
+
+        return _sharding.stacked_shardings(tree, self.mesh, self.axis_names, rules)
+
+    def shard_stacked(self, tree, rules=None):
         """device_put a flat-stacked pytree onto the mesh."""
         import jax as _jax
 
-        return _jax.tree.map(
-            lambda x: _jax.device_put(x, self.stacked_sharding()), tree
-        )
+        shardings = self.stacked_shardings(tree, rules)
+        return _jax.tree.map(_jax.device_put, tree, shardings)
 
     def stack_shape(self) -> tuple[int, ...]:
         """Leading axes a global stacked array must carry."""

@@ -20,7 +20,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from consensusml_tpu.data.synthetic import SyntheticClassification, SyntheticLM
+from consensusml_tpu.data.synthetic import (
+    SyntheticClassification,
+    SyntheticLM,
+    mlm_corrupt,
+)
 
 __all__ = ["native_round_batches", "native_lm_round_batches"]
 
@@ -75,6 +79,7 @@ def native_lm_round_batches(
     rounds: int,
     seed: int = 0,
     mlm_rate: float = 0.0,
+    mask_token: int | None = None,
     depth: int = 4,
     nthreads: int = 2,
 ):
@@ -106,11 +111,4 @@ def native_lm_round_batches(
             if mlm_rate <= 0:
                 yield {"input_ids": jnp.asarray(ids)}
             else:
-                rng = np.random.default_rng((seed, r, 10**6))
-                mask = rng.random(ids.shape) < mlm_rate
-                corrupted = np.where(mask, dataset.mask_token, ids)
-                yield {
-                    "input_ids": jnp.asarray(corrupted, jnp.int32),
-                    "labels": jnp.asarray(ids, jnp.int32),
-                    "mlm_mask": jnp.asarray(mask, jnp.float32),
-                }
+                yield mlm_corrupt(ids, dataset, seed, r, mlm_rate, mask_token)

@@ -91,6 +91,29 @@ class SyntheticLM:
         return out.reshape(*shape, self.seq_len)
 
 
+def mlm_corrupt(
+    ids: np.ndarray,
+    dataset: SyntheticLM,
+    seed: int,
+    r: int,
+    mlm_rate: float,
+    mask_token: int | None = None,
+) -> dict:
+    """BERT-style corruption of a round's token block, keyed (seed, round).
+
+    Shared by the Python and native loader paths so the two streams stay
+    bit-identical for the same (seed, round)."""
+    rng = np.random.default_rng((seed, r, 10**6))
+    mask = rng.random(ids.shape) < mlm_rate
+    mtok = dataset.mask_token if mask_token is None else mask_token
+    corrupted = np.where(mask, mtok, ids)
+    return {
+        "input_ids": jnp.asarray(corrupted, jnp.int32),
+        "labels": jnp.asarray(ids, jnp.int32),
+        "mlm_mask": jnp.asarray(mask, jnp.float32),
+    }
+
+
 def lm_round_batches(
     dataset: SyntheticLM,
     world_size: int,
@@ -117,15 +140,7 @@ def lm_round_batches(
         if mlm_rate <= 0:
             yield {"input_ids": jnp.asarray(ids)}
         else:
-            rng = np.random.default_rng((seed, r, 10**6))
-            mask = rng.random(ids.shape) < mlm_rate
-            mtok = dataset.mask_token if mask_token is None else mask_token
-            corrupted = np.where(mask, mtok, ids)
-            yield {
-                "input_ids": jnp.asarray(corrupted, jnp.int32),
-                "labels": jnp.asarray(ids, jnp.int32),
-                "mlm_mask": jnp.asarray(mask, jnp.float32),
-            }
+            yield mlm_corrupt(ids, dataset, seed, r, mlm_rate, mask_token)
 
 
 def round_batches(

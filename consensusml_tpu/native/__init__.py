@@ -65,7 +65,12 @@ def _load() -> ctypes.CDLL | None:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.SubprocessError) as e:
-            _load_failed = f"{type(e).__name__}: {e}"
+            # keep the compiler's stderr — without it a failed `make` is
+            # undebuggable from the raised message alone
+            detail = getattr(e, "stderr", None)
+            _load_failed = f"{type(e).__name__}: {e}" + (
+                f"\n--- build stderr ---\n{detail}" if detail else ""
+            )
             return None
         lib.cml_quant_int8.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _i8p, _f32p]
         lib.cml_dequant_int8.argtypes = [_i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p]

@@ -1,0 +1,119 @@
+"""Regex-rule model sharding for hybrid gossip-DP x model-parallel runs.
+
+The reference's parallelism story is pure data-parallel gossip (SURVEY.md
+§2: one worker = one GPU; its largest model, Llama-2-7B, fits one worker
+via LoRA). On TPU we generalize the worker: a gossip worker is a SUBMESH —
+the device mesh is ``(*topology.mesh_shape, *model_axes)``, gossip
+collectives are manual (``shard_map`` over the worker axes), and the model
+axes stay in XLA's *auto* sharding mode, so tensor-parallel collectives
+inside a worker are inserted by the compiler from these sharding
+annotations (the scaling-book recipe: annotate, don't hand-schedule).
+
+Rules are ``(regex, spec)`` pairs matched against the ``/``-joined
+parameter path; ``spec`` names mesh axes for the TRAILING dims of the
+leaf. The first matching rule wins; unmatched leaves are replicated over
+the model axes. The same rules shard params, optimizer state, and gossip
+state, because optax/CHOCO trees embed the param tree (path suffixes
+still match).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = [
+    "ShardingRules",
+    "spec_for_path",
+    "llama_tp_rules",
+    "gpt2_tp_rules",
+]
+
+# (path regex, trailing-dim partition spec) — axis names must exist on the
+# WorkerMesh's model axes.
+ShardingRules = Sequence[tuple[str, tuple[str | None, ...]]]
+
+
+def spec_for_path(
+    path: str, ndim: int, rules: ShardingRules | None
+) -> tuple[str | None, ...]:
+    """Trailing-dim spec for one leaf: first matching rule, else replicated.
+
+    A rule's spec applies to the LAST ``len(spec)`` dims; a spec longer
+    than the leaf's rank is an error (catches rules written for the wrong
+    tensor).
+    """
+    if rules:
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                if len(spec) > ndim:
+                    raise ValueError(
+                        f"sharding rule {pattern!r} wants {len(spec)} dims but "
+                        f"leaf {path!r} has only {ndim}"
+                    )
+                return (None,) * (ndim - len(spec)) + tuple(spec)
+    return (None,) * ndim
+
+
+def tree_paths(tree: Any) -> Any:
+    """Same-structure tree of '/'-joined string paths."""
+    return jax.tree.map_with_path(
+        lambda p, _: jax.tree_util.keystr(p, simple=True, separator="/"), tree
+    )
+
+
+def stacked_shardings(
+    tree: Any, mesh, flat_axes: tuple[str, ...], rules: ShardingRules | None
+) -> Any:
+    """NamedSharding tree for FLAT-stacked leaves ``(W, ...)``.
+
+    The leading axis is split over all ``flat_axes`` (the worker axes,
+    row-major); trailing dims follow ``rules`` over the model axes.
+    """
+
+    def one(path, leaf):
+        ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        pathstr = jax.tree_util.keystr(path, simple=True, separator="/")
+        spec = spec_for_path(pathstr, ndim - 1, rules)
+        return NamedSharding(mesh, PartitionSpec(flat_axes, *spec))
+
+    return jax.tree.map_with_path(one, tree)
+
+
+# ---------------------------------------------------------------------------
+# stock rule sets (Megatron-style 1-D tensor parallelism)
+# ---------------------------------------------------------------------------
+
+
+def llama_tp_rules(axis: str = "tp") -> ShardingRules:
+    """Column-parallel q/k/v/gate/up, row-parallel o/down — one psum per
+    attention block and one per MLP block, inserted by XLA from these
+    annotations. LoRA adapters follow their base projection's split so the
+    adapter matmul needs no extra collective."""
+    return [
+        (r"(q|k|v)_proj/(base/kernel|lora_b)", (None, axis)),
+        (r"o_proj/base/kernel", (axis, None)),
+        (r"o_proj/lora_a", (axis, None)),
+        (r"(gate|up)_proj/kernel", (None, axis)),
+        (r"down_proj/kernel", (axis, None)),
+        (r"lm_head/kernel", (None, axis)),
+        (r"tok_emb/embedding", (None, axis)),
+    ]
+
+
+def gpt2_tp_rules(axis: str = "tp") -> ShardingRules:
+    """Head-parallel attention + column/row-split MLP for the GPT-2 layout
+    (qkv kernel ``(hidden, heads, 3*head_dim)``, out kernel
+    ``(heads, head_dim, hidden)`` — shard the heads dim)."""
+    return [
+        (r"qkv/kernel", (None, axis, None)),
+        (r"qkv/bias", (axis, None)),
+        (r"/out/kernel", (axis, None, None)),  # '/' so mlp_out doesn't match
+        (r"mlp_in/kernel", (None, axis)),
+        (r"mlp_in/bias", (axis,)),
+        (r"mlp_out/kernel", (axis, None)),
+        (r"(wte|wpe)/embedding", (None, axis)),
+    ]

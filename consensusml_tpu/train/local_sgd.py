@@ -18,6 +18,7 @@ gossip via the mixing matrix. Cross-validated in tests/test_local_sgd.py.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -203,10 +204,19 @@ def make_collective_train_step(
         lambda x: x.reshape(world, *x.shape[n_axes:]), t
     )
 
-    @jax.shard_map(
+    # With a model submesh (WorkerMesh.model_axes), shard_map goes
+    # partial-manual: gossip axes are manual (ppermute/psum written here),
+    # model axes stay auto — XLA inserts the intra-worker tensor-parallel
+    # collectives from the param sharding annotations.
+    manual = wmesh.manual_axes()
+    shard_kwargs = {} if manual is None else {"axis_names": manual}
+
+    @functools.partial(
+        jax.shard_map,
         mesh=wmesh.mesh,
         in_specs=(worker, worker),
         out_specs=(worker, P()),
+        **shard_kwargs,
     )
     def sharded_round(state: TrainState, batch: Any):
         state = _squeeze(state, n_axes)
@@ -233,10 +243,20 @@ def make_collective_train_step(
         }
         return _unsqueeze(new_state, n_axes), metrics
 
-    @jax.jit
-    def train_step(state: TrainState, batch: Any):
+    # donate the old TrainState so XLA updates params/opt buffers in place —
+    # without this every round copies the full replica set through HBM
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def jitted_step(state: TrainState, batch: Any):
         new_state, metrics = sharded_round(to_mesh(state), to_mesh(batch))
         return to_flat(new_state), metrics
+
+    if manual is None:
+        return jitted_step
+
+    def train_step(state: TrainState, batch: Any):
+        # auto-axis sharding propagation needs the ambient mesh set
+        with jax.sharding.set_mesh(wmesh.mesh):
+            return jitted_step(state, batch)
 
     return train_step
 
@@ -260,7 +280,7 @@ def make_simulated_train_step(
     topo = cfg.gossip.topology
     w = simulated.mixing_matrix(topo)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: Any):
         def worker(params, model_state, opt_state, rng, batch):
             return _inner_loop(cfg, loss_fn, params, model_state, opt_state, rng, batch)

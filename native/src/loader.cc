@@ -84,18 +84,20 @@ class Loader {
     for (auto& t : threads_) t.join();
   }
 
-  // Blocks until the next-in-order slot is ready; returns its index and
-  // exposes its buffers. Returns -1 only after Stop() (not used today).
+  // Blocks until an in-order slot is ready; returns its index and exposes
+  // its buffers. Returns -1 only after destruction begins. Each caller
+  // claims its sequence number before waiting, so concurrent consumers
+  // wait on distinct slots instead of racing for (and possibly deadlocking
+  // on) the same one.
   int Acquire(float** fptr, int32_t** iptr) {
     std::unique_lock<std::mutex> lk(mu_);
-    const uint64_t want = next_consume_;
+    const uint64_t want = next_consume_++;
     Slot& slot = slots_[want % depth_];
     cv_consumer_.wait(lk, [&] {
       return stop_ || (slot.state == SlotState::kReady && slot.seq == want);
     });
     if (stop_) return -1;
     slot.state = SlotState::kInUse;
-    next_consume_++;
     *fptr = slot.floats.data();
     *iptr = slot.ints.data();
     return (int)(want % depth_);
