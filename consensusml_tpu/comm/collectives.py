@@ -23,7 +23,14 @@ import jax.numpy as jnp
 
 from consensusml_tpu.topology import Shift, Topology
 
-__all__ = ["ppermute_shift", "mix", "mix_tree", "consensus_error"]
+__all__ = [
+    "ppermute_shift",
+    "mix",
+    "mix_tree",
+    "mix_masked",
+    "mix_tree_masked",
+    "consensus_error",
+]
 
 
 def ppermute_shift(x: jax.Array, topology: Topology, shift: Shift) -> jax.Array:
@@ -63,6 +70,47 @@ def mix(x: jax.Array, topology: Topology) -> jax.Array:
 
 def mix_tree(tree: Any, topology: Topology) -> Any:
     return jax.tree.map(lambda x: mix(x, topology), tree)
+
+
+def mix_masked(x: jax.Array, topology: Topology, alive: jax.Array,
+               alive_nbrs: list[jax.Array] | None = None) -> jax.Array:
+    """Alive-mask-aware gossip round (see consensus.faults for semantics):
+    a dead neighbor's weight folds back onto self, a dead worker keeps its
+    own value. ``alive`` is this worker's scalar 0/1 flag; ``alive_nbrs``
+    caches the per-shift ppermuted flags so a pytree mix exchanges them
+    once, not once per leaf.
+
+    The reference's NCCL design would need send/recv timeouts and a
+    communicator rebuild to survive this; here the dead peer's payload
+    still rides the (static) collective but is zero-weighted out.
+    """
+    if topology.uses_psum:
+        # dense: acc_i = S/n + x_i * (n - A)/n, S = sum_j a_j x_j, A = sum_j a_j
+        n = float(topology.world_size)
+        xf = jnp.asarray(x, jnp.float32)
+        s = jax.lax.psum(alive * xf, topology.axis_names)
+        a = jax.lax.psum(alive, topology.axis_names)
+        acc = s / n + xf * (n - a) / n
+        return jnp.where(alive > 0, acc, xf).astype(x.dtype)
+    if alive_nbrs is None:
+        alive_nbrs = [ppermute_shift(alive, topology, s) for s in topology.shifts]
+    xf = jnp.asarray(x, jnp.float32)
+    acc = xf * topology.self_weight
+    for s, a_n in zip(topology.shifts, alive_nbrs):
+        x_n = jnp.asarray(ppermute_shift(x, topology, s), jnp.float32)
+        acc = acc + s.weight * (a_n * x_n + (1.0 - a_n) * xf)
+    return jnp.where(alive > 0, acc, xf).astype(x.dtype)
+
+
+def mix_tree_masked(tree: Any, topology: Topology, alive: jax.Array) -> Any:
+    alive_nbrs = (
+        None
+        if topology.uses_psum
+        else [ppermute_shift(alive, topology, s) for s in topology.shifts]
+    )
+    return jax.tree.map(
+        lambda x: mix_masked(x, topology, alive, alive_nbrs), tree
+    )
 
 
 def consensus_error(tree: Any, topology: Topology) -> jax.Array:

@@ -15,3 +15,9 @@ from consensusml_tpu.consensus.engine import (  # noqa: F401
     ConsensusEngine,
     GossipConfig,
 )
+from consensusml_tpu.consensus.faults import (  # noqa: F401
+    FaultConfig,
+    draw_alive,
+    masked_mixing_matrix,
+    tree_all_finite,
+)

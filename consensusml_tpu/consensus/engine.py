@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from consensusml_tpu.comm import collectives, simulated
 from consensusml_tpu.compress.base import Compressor
+from consensusml_tpu.consensus.faults import FaultConfig, masked_mixing_matrix
 from consensusml_tpu.topology import Topology
 
 __all__ = ["GossipConfig", "ChocoState", "ConsensusEngine"]
@@ -53,12 +54,20 @@ class GossipConfig:
     compressor: Compressor | None = None  # None => exact mixing
     gamma: float = 1.0  # CHOCO consensus step size (ignored when exact)
     path_filter: Any = None  # Callable[[tuple], bool] | None
+    faults: FaultConfig | None = None  # None => no fault model
 
     def __post_init__(self):
         if self.compressor is not None and self.path_filter is not None:
             raise NotImplementedError(
                 "compressed gossip with a path_filter is not supported yet; "
                 "compress everything or filter exact gossip"
+            )
+        if self.compressor is not None and self.faults is not None:
+            raise NotImplementedError(
+                "fault-tolerant COMPRESSED gossip is not supported yet: "
+                "CHOCO's xhat tracking assumes every peer applies every "
+                "innovation, which a dropped round violates; use exact "
+                "gossip with faults, or compression without faults"
             )
 
 
@@ -87,19 +96,42 @@ class ConsensusEngine:
         return ChocoState(xhat=zeros, s=jax.tree.map(jnp.copy, zeros))
 
     # ---- collective backend (call inside shard_map) ---------------------
-    def round_collective(self, params: Any, state: ChocoState | None):
-        """One gossip round, per-worker view. Returns (params, state)."""
+    def round_collective(
+        self, params: Any, state: ChocoState | None, alive: jax.Array | None = None
+    ):
+        """One gossip round, per-worker view. Returns (params, state).
+
+        ``alive`` (scalar 0/1, only with ``config.faults``): this worker's
+        participation flag — see :mod:`consensusml_tpu.consensus.faults`.
+        """
         topo = self.topology
         if not self.compressed:
             flt = self.config.path_filter
+            if alive is not None:
+                # exchange the alive flags once, not once per filtered leaf
+                alive_nbrs = (
+                    None
+                    if topo.uses_psum
+                    else [
+                        collectives.ppermute_shift(alive, topo, s)
+                        for s in topo.shifts
+                    ]
+                )
+                mix_one = lambda x: collectives.mix_masked(
+                    x, topo, alive, alive_nbrs
+                )
+                mix_all = lambda t: jax.tree.map(mix_one, t)
+            else:
+                mix_one = lambda x: collectives.mix(x, topo)
+                mix_all = lambda t: collectives.mix_tree(t, topo)
             if flt is not None:
                 return (
                     jax.tree_util.tree_map_with_path(
-                        lambda p, x: collectives.mix(x, topo) if flt(p) else x, params
+                        lambda p, x: mix_one(x) if flt(p) else x, params
                     ),
                     None,
                 )
-            return collectives.mix_tree(params, topo), None
+            return mix_all(params), None
 
         comp = self.config.compressor
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
@@ -131,9 +163,21 @@ class ConsensusEngine:
         return x_new, ChocoState(xhat=xhat, s=s)
 
     # ---- simulated backend (stacked leading worker axis) ----------------
-    def round_simulated(self, params: Any, state: ChocoState | None, w: jax.Array):
-        """One gossip round on stacked arrays (leading axis = workers)."""
+    def round_simulated(
+        self,
+        params: Any,
+        state: ChocoState | None,
+        w: jax.Array,
+        alive: jax.Array | None = None,
+    ):
+        """One gossip round on stacked arrays (leading axis = workers).
+
+        ``alive`` (``(world,)`` of 0/1, only with ``config.faults``): the
+        per-worker participation flags for this round.
+        """
         if not self.compressed:
+            if alive is not None:
+                w = masked_mixing_matrix(w, alive)
             flt = self.config.path_filter
             if flt is not None:
                 return (

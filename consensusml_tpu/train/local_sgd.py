@@ -27,7 +27,13 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from consensusml_tpu.comm import WorkerMesh, simulated
-from consensusml_tpu.consensus import ChocoState, ConsensusEngine, GossipConfig
+from consensusml_tpu.consensus import (
+    ChocoState,
+    ConsensusEngine,
+    GossipConfig,
+    draw_alive,
+    tree_all_finite,
+)
 
 __all__ = [
     "LocalSGDConfig",
@@ -210,6 +216,7 @@ def make_collective_train_step(
     # collectives from the param sharding annotations.
     manual = wmesh.manual_axes()
     shard_kwargs = {} if manual is None else {"axis_names": manual}
+    faults = cfg.gossip.faults
 
     @functools.partial(
         jax.shard_map,
@@ -224,8 +231,34 @@ def make_collective_train_step(
         params, model_state, opt_state, rng, loss = _inner_loop(
             cfg, loss_fn, state.params, state.model_state, state.opt_state, state.rng, batch
         )
+        if faults is None:
+            alive = None
+            mean_loss = jax.lax.pmean(loss, topo.axis_names)
+        else:
+            rng, fsub = jax.random.split(rng)
+            inject = draw_alive(fsub, faults.drop_prob)  # comm failure: local
+            # steps survive, the worker just misses this gossip round
+            ok = (
+                # model_state gossips too, so it must pass the finite check
+                tree_all_finite(loss, (params, model_state))
+                if faults.detect_nonfinite
+                else jnp.ones((), jnp.float32)
+            )
+            # a non-finite inner loop is rolled back entirely so the NaN
+            # neither persists locally nor reaches the wire
+            revert = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(ok > 0, a, b), new, old
+            )
+            params = revert(params, state.params)
+            model_state = revert(model_state, state.model_state)
+            opt_state = revert(opt_state, state.opt_state)
+            alive = inject * ok
+            n_ok = jax.lax.psum(ok, topo.axis_names)
+            mean_loss = jax.lax.psum(ok * loss, topo.axis_names) / jnp.maximum(
+                n_ok, 1.0
+            )
         mixed, gossip = engine.round_collective(
-            _gossiped(params, model_state), state.gossip
+            _gossiped(params, model_state), state.gossip, alive
         )
         params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_collective(params)
@@ -238,9 +271,11 @@ def make_collective_train_step(
             rng=rng,
         )
         metrics = {
-            "loss": jax.lax.pmean(loss, topo.axis_names),
+            "loss": mean_loss,
             "consensus_error": err,
         }
+        if faults is not None:
+            metrics["alive_frac"] = jax.lax.pmean(alive, topo.axis_names)
         return _unsqueeze(new_state, n_axes), metrics
 
     # donate the old TrainState so XLA updates params/opt buffers in place —
@@ -279,6 +314,7 @@ def make_simulated_train_step(
     engine = cfg.engine()
     topo = cfg.gossip.topology
     w = simulated.mixing_matrix(topo)
+    faults = cfg.gossip.faults
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: Any):
@@ -288,8 +324,32 @@ def make_simulated_train_step(
         params, model_state, opt_state, rng, losses = jax.vmap(worker)(
             state.params, state.model_state, state.opt_state, state.rng, batch
         )
+        if faults is None:
+            alive = None
+            mean_loss = jnp.mean(losses)
+        else:
+            # identical per-worker draws/checks as the collective backend
+            rng, fsub = (
+                lambda s: (s[:, 0], s[:, 1])
+            )(jax.vmap(jax.random.split)(rng))
+            inject = jax.vmap(draw_alive, in_axes=(0, None))(fsub, faults.drop_prob)
+            ok = (
+                # model_state gossips too, so it must pass the finite check
+                jax.vmap(tree_all_finite)(losses, (params, model_state))
+                if faults.detect_nonfinite
+                else jnp.ones_like(losses)
+            )
+            bc = lambda m, x: m.reshape(m.shape + (1,) * (x.ndim - 1))
+            revert = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(bc(ok, a) > 0, a, b), new, old
+            )
+            params = revert(params, state.params)
+            model_state = revert(model_state, state.model_state)
+            opt_state = revert(opt_state, state.opt_state)
+            alive = inject * ok
+            mean_loss = jnp.sum(ok * losses) / jnp.maximum(jnp.sum(ok), 1.0)
         mixed, gossip = engine.round_simulated(
-            _gossiped(params, model_state), state.gossip, w
+            _gossiped(params, model_state), state.gossip, w, alive
         )
         params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_simulated(params)
@@ -301,6 +361,9 @@ def make_simulated_train_step(
             gossip=gossip,
             rng=rng,
         )
-        return new_state, {"loss": jnp.mean(losses), "consensus_error": err}
+        metrics = {"loss": mean_loss, "consensus_error": err}
+        if faults is not None:
+            metrics["alive_frac"] = jnp.mean(alive)
+        return new_state, metrics
 
     return train_step

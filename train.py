@@ -33,6 +33,9 @@ def parse_args(argv=None):
     p.add_argument("--scale", default=None, choices=["smoke", "full"],
                    help="workload size (default: smoke on cpu, full on tpu)")
     p.add_argument("--rounds", type=int, default=50)
+    p.add_argument("--drop-prob", type=float, default=0.0,
+                   help="per-round worker dropout probability (fault injection; "
+                        "non-finite failure detection is enabled alongside it)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -84,6 +87,18 @@ def main(argv=None) -> int:
     platform = jax.default_backend()
     scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
     bundle = configs.build(args.config, scale)
+
+    if args.drop_prob > 0:
+        import dataclasses
+
+        from consensusml_tpu.consensus import FaultConfig
+
+        bundle.cfg = dataclasses.replace(
+            bundle.cfg,
+            gossip=dataclasses.replace(
+                bundle.cfg.gossip, faults=FaultConfig(drop_prob=args.drop_prob)
+            ),
+        )
 
     backend = args.backend
     if backend == "auto":
