@@ -25,6 +25,14 @@ from consensusml_tpu.compress.base import (  # noqa: F401
     Int8Payload,
     TopKPayload,
 )
+from consensusml_tpu.compress.extra import (  # noqa: F401
+    LowRankPayload,
+    PowerSGDCompressor,
+    QSGDCompressor,
+    RandomKCompressor,
+    SignCompressor,
+    SignPayload,
+)
 from consensusml_tpu.compress.reference import (  # noqa: F401
     Int8Compressor,
     TopKCompressor,

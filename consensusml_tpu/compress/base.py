@@ -71,7 +71,14 @@ class Compressor(abc.ABC):
     ``decompress(compress(x))`` has ``x``'s shape and dtype. Compressors
     are applied leaf-wise over parameter/gradient pytrees by the consensus
     engine; all shapes in the payload are static at trace time.
+
+    Stochastic codecs (random-k, stochastic rounding) set
+    ``stochastic = True`` and take ``compress(x, rng=key)``; the engine
+    threads per-round worker rng into them so both execution backends draw
+    identical randomness.
     """
+
+    stochastic: bool = False
 
     @abc.abstractmethod
     def compress(self, x: jax.Array):
@@ -83,15 +90,31 @@ class Compressor(abc.ABC):
 
     def wire_bytes(self, shape: tuple[int, ...], dtype) -> int:
         """Bytes actually exchanged per tensor — for bandwidth accounting."""
-        payload = jax.eval_shape(
-            self.compress, jax.ShapeDtypeStruct(shape, dtype)
+        fn = (
+            (lambda x: self.compress(x, rng=jax.random.key(0)))
+            if self.stochastic
+            else self.compress
         )
+        payload = jax.eval_shape(fn, jax.ShapeDtypeStruct(shape, dtype))
         return sum(
             leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(payload)
         )
 
-    def compress_tree(self, tree: Any) -> Any:
-        return jax.tree.map(self.compress, tree)
+    def compress_tree(self, tree: Any, rng: jax.Array | None = None) -> Any:
+        """Leaf-wise compress; stochastic codecs get ``fold_in(rng, i)``
+        per leaf index — deterministic given the caller's key."""
+        if not self.stochastic:
+            return jax.tree.map(self.compress, tree)
+        if rng is None:
+            raise ValueError(
+                f"{type(self).__name__} is stochastic and needs an rng"
+            )
+        leaves, treedef = jax.tree.flatten(tree)
+        out = [
+            self.compress(x, rng=jax.random.fold_in(rng, i))
+            for i, x in enumerate(leaves)
+        ]
+        return jax.tree.unflatten(treedef, out)
 
     def decompress_tree(self, payload_tree: Any, like: Any) -> Any:
         """Decompress a payload tree; ``like`` gives the original structure."""
@@ -128,12 +151,19 @@ class ComposedCompressor(Compressor):
     inner: Compressor  # produces a TopKPayload
     outer: Compressor  # applied to payload.values
 
-    def compress(self, x: jax.Array):
-        p = self.inner.compress(x)
+    @property
+    def stochastic(self) -> bool:  # type: ignore[override]
+        return self.inner.stochastic or self.outer.stochastic
+
+    def compress(self, x: jax.Array, rng: jax.Array | None = None):
+        sub = lambda c, tag: (
+            {"rng": jax.random.fold_in(rng, tag)} if c.stochastic else {}
+        )
+        p = self.inner.compress(x, **sub(self.inner, 0))
         if not isinstance(p, TopKPayload):
             raise TypeError("ComposedCompressor.inner must produce TopKPayload")
         return TopKPayload(
-            values=self.outer.compress(p.values),
+            values=self.outer.compress(p.values, **sub(self.outer, 1)),
             indices=p.indices,
             shape=p.shape,
             dtype=p.dtype,

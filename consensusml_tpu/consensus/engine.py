@@ -57,11 +57,6 @@ class GossipConfig:
     faults: FaultConfig | None = None  # None => no fault model
 
     def __post_init__(self):
-        if self.compressor is not None and self.path_filter is not None:
-            raise NotImplementedError(
-                "compressed gossip with a path_filter is not supported yet; "
-                "compress everything or filter exact gossip"
-            )
         if self.compressor is not None and self.faults is not None:
             raise NotImplementedError(
                 "fault-tolerant COMPRESSED gossip is not supported yet: "
@@ -83,26 +78,53 @@ class ConsensusEngine:
     def compressed(self) -> bool:
         return self.config.compressor is not None
 
+    # ---- path filtering --------------------------------------------------
+    def _select(self, tree: Any):
+        """Split ``tree`` into the gossiped-leaf list + a rebuild closure.
+
+        With a ``path_filter``, CHOCO runs on the selected leaves ONLY (a
+        flat list is itself a pytree), so e.g. a LoRA run keeps xhat/s
+        state for the adapters rather than for all 7B frozen weights.
+        """
+        flt = self.config.path_filter
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        sel = [x for p, x in flat if flt(p)]
+
+        def rebuild(new_sel: list) -> Any:
+            it = iter(new_sel)
+            leaves = [next(it) if flt(p) else x for p, x in flat]
+            return jax.tree.unflatten(treedef, leaves)
+
+        return sel, rebuild
+
     # ---- state ----------------------------------------------------------
     def init_state(self, params: Any) -> ChocoState | None:
         """Zero CHOCO state shaped like ``params`` (None for exact gossip).
 
         Works for both backends: pass per-worker params (collective) or
-        stacked params (simulated).
+        stacked params (simulated). With a ``path_filter`` the state only
+        covers the filtered (gossiped) leaves.
         """
         if not self.compressed:
             return None
+        if self.config.path_filter is not None:
+            params, _ = self._select(params)
         zeros = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
         return ChocoState(xhat=zeros, s=jax.tree.map(jnp.copy, zeros))
 
     # ---- collective backend (call inside shard_map) ---------------------
     def round_collective(
-        self, params: Any, state: ChocoState | None, alive: jax.Array | None = None
+        self,
+        params: Any,
+        state: ChocoState | None,
+        alive: jax.Array | None = None,
+        rng: jax.Array | None = None,
     ):
         """One gossip round, per-worker view. Returns (params, state).
 
         ``alive`` (scalar 0/1, only with ``config.faults``): this worker's
         participation flag — see :mod:`consensusml_tpu.consensus.faults`.
+        ``rng``: this worker's key for stochastic codecs (random-k, QSGD).
         """
         topo = self.topology
         if not self.compressed:
@@ -134,10 +156,14 @@ class ConsensusEngine:
             return mix_all(params), None
 
         comp = self.config.compressor
+        rebuild = None
+        if self.config.path_filter is not None:
+            # CHOCO runs on the filtered leaves; the rest pass through
+            params, rebuild = self._select(params)
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         delta = jax.tree.map(jnp.subtract, x, state.xhat)
-        q = comp.compress_tree(delta)
+        q = comp.compress_tree(delta, rng)
         dec_q = comp.decompress_tree(q, like=delta)
         xhat = jax.tree.map(jnp.add, state.xhat, dec_q)
 
@@ -160,6 +186,8 @@ class ConsensusEngine:
         x_new = jax.tree.map(
             lambda new, old: new.astype(old.dtype), x_new, params
         )
+        if rebuild is not None:
+            x_new = rebuild(x_new)
         return x_new, ChocoState(xhat=xhat, s=s)
 
     # ---- simulated backend (stacked leading worker axis) ----------------
@@ -169,11 +197,14 @@ class ConsensusEngine:
         state: ChocoState | None,
         w: jax.Array,
         alive: jax.Array | None = None,
+        rng: jax.Array | None = None,
     ):
         """One gossip round on stacked arrays (leading axis = workers).
 
         ``alive`` (``(world,)`` of 0/1, only with ``config.faults``): the
-        per-worker participation flags for this round.
+        per-worker participation flags for this round. ``rng``: stacked
+        ``(world,)`` keys for stochastic codecs — the same per-worker draws
+        the collective backend makes.
         """
         if not self.compressed:
             if alive is not None:
@@ -190,13 +221,34 @@ class ConsensusEngine:
             return simulated.mix_tree_stacked(params, w), None
 
         comp = self.config.compressor
+        rebuild = None
+        if self.config.path_filter is not None:
+            params, rebuild = self._select(params)
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         delta = jax.tree.map(jnp.subtract, x, state.xhat)
-        dec_q = jax.tree.map(
-            lambda d: jax.vmap(lambda v: comp.decompress(comp.compress(v)))(d),
-            delta,
-        )
+        # per-worker compress; leaf index folds into the key exactly like
+        # Compressor.compress_tree does on the collective side
+        leaves, treedef = jax.tree.flatten(delta)
+        if comp.stochastic:
+            if rng is None:
+                raise ValueError(
+                    f"{type(comp).__name__} is stochastic and needs stacked rng"
+                )
+            dec_leaves = [
+                jax.vmap(
+                    lambda v, k, i=i: comp.decompress(
+                        comp.compress(v, rng=jax.random.fold_in(k, i))
+                    )
+                )(d, rng)
+                for i, d in enumerate(leaves)
+            ]
+        else:
+            dec_leaves = [
+                jax.vmap(lambda v: comp.decompress(comp.compress(v)))(d)
+                for d in leaves
+            ]
+        dec_q = jax.tree.unflatten(treedef, dec_leaves)
         xhat = jax.tree.map(jnp.add, state.xhat, dec_q)
         recv = simulated.mix_tree_stacked(dec_q, w)
         s = jax.tree.map(jnp.add, state.s, recv)
@@ -204,6 +256,8 @@ class ConsensusEngine:
             lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat
         )
         x_new = jax.tree.map(lambda new, old: new.astype(old.dtype), x_new, params)
+        if rebuild is not None:
+            x_new = rebuild(x_new)
         return x_new, ChocoState(xhat=xhat, s=s)
 
     # ---- metrics --------------------------------------------------------

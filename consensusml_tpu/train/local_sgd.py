@@ -217,6 +217,8 @@ def make_collective_train_step(
     manual = wmesh.manual_axes()
     shard_kwargs = {} if manual is None else {"axis_names": manual}
     faults = cfg.gossip.faults
+    comp = cfg.gossip.compressor
+    stochastic_comp = comp is not None and comp.stochastic
 
     @functools.partial(
         jax.shard_map,
@@ -257,8 +259,12 @@ def make_collective_train_step(
             mean_loss = jax.lax.psum(ok * loss, topo.axis_names) / jnp.maximum(
                 n_ok, 1.0
             )
+        if stochastic_comp:
+            rng, gsub = jax.random.split(rng)
+        else:
+            gsub = None
         mixed, gossip = engine.round_collective(
-            _gossiped(params, model_state), state.gossip, alive
+            _gossiped(params, model_state), state.gossip, alive, gsub
         )
         params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_collective(params)
@@ -315,6 +321,8 @@ def make_simulated_train_step(
     topo = cfg.gossip.topology
     w = simulated.mixing_matrix(topo)
     faults = cfg.gossip.faults
+    comp = cfg.gossip.compressor
+    stochastic_comp = comp is not None and comp.stochastic
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: Any):
@@ -348,8 +356,14 @@ def make_simulated_train_step(
             opt_state = revert(opt_state, state.opt_state)
             alive = inject * ok
             mean_loss = jnp.sum(ok * losses) / jnp.maximum(jnp.sum(ok), 1.0)
+        if stochastic_comp:
+            rng, gsub = (
+                lambda s: (s[:, 0], s[:, 1])
+            )(jax.vmap(jax.random.split)(rng))
+        else:
+            gsub = None
         mixed, gossip = engine.round_simulated(
-            _gossiped(params, model_state), state.gossip, w, alive
+            _gossiped(params, model_state), state.gossip, w, alive, gsub
         )
         params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_simulated(params)

@@ -1,0 +1,217 @@
+"""Extended codec suite: random-k, QSGD, sign, PowerSGD + filtered CHOCO.
+
+Oracles: round-trip shape/dtype, unbiasedness (Monte Carlo over rng draws)
+for the unbiased codecs, wire-size accounting, backend cross-agreement for
+stochastic compressed gossip, and LoRA-style filtered compression.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.comm import WorkerMesh
+from consensusml_tpu.compress import (
+    PowerSGDCompressor,
+    QSGDCompressor,
+    RandomKCompressor,
+    SignCompressor,
+)
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.data import SyntheticClassification, round_batches
+from consensusml_tpu.models import MLP, mlp_loss_fn
+from consensusml_tpu.topology import RingTopology
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    init_stacked_state,
+    make_collective_train_step,
+    make_simulated_train_step,
+)
+
+
+@pytest.fixture
+def x():
+    return jnp.asarray(np.random.default_rng(0).normal(size=(33, 17)), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# round-trip + statistical properties
+# ---------------------------------------------------------------------------
+
+
+def test_randomk_roundtrip_and_unbiased(x):
+    comp = RandomKCompressor(ratio=0.25, unbiased=True)
+    acc = jnp.zeros_like(x)
+    n_draws = 300
+    for i in range(n_draws):
+        y = comp.decompress(comp.compress(x, rng=jax.random.key(i)))
+        assert y.shape == x.shape and y.dtype == x.dtype
+        acc = acc + y
+    # E[dec(comp(x))] = x (coordinates scaled by n/k). Per-coordinate
+    # variance of one draw is x^2 (n/k - 1) = 3 x^2, so the Monte Carlo
+    # mean's sigma is |x| sqrt(3/n_draws); allow 4.5 sigma + float slack.
+    sigma = np.abs(np.asarray(x)) * np.sqrt(3.0 / n_draws)
+    err = np.abs(np.asarray(acc / n_draws) - np.asarray(x))
+    assert (err <= 4.5 * sigma + 1e-3).all(), f"bias beyond 4.5 sigma: {err.max()}"
+
+
+def test_qsgd_roundtrip_and_unbiased(x):
+    comp = QSGDCompressor(chunk=64)
+    acc = jnp.zeros_like(x)
+    n_draws = 300
+    for i in range(n_draws):
+        y = comp.decompress(comp.compress(x, rng=jax.random.key(i)))
+        assert y.shape == x.shape and y.dtype == x.dtype
+        # quantization error bounded by one level
+        assert float(jnp.max(jnp.abs(y - x))) <= float(jnp.max(jnp.abs(x))) / 127 + 1e-6
+        acc = acc + y
+    np.testing.assert_allclose(np.asarray(acc / n_draws), np.asarray(x), atol=0.01)
+
+
+def test_sign_roundtrip(x):
+    comp = SignCompressor(chunk=64)
+    p = comp.compress(x)
+    y = comp.decompress(p)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # decoded signs match input signs, magnitude is per-chunk mean |x|
+    np.testing.assert_array_equal(
+        np.sign(np.asarray(y)).ravel(), np.where(np.asarray(x).ravel() >= 0, 1, -1)
+    )
+    # 1 bit/elem + scales: payload must be ~32x smaller than f32
+    wire = comp.wire_bytes(x.shape, jnp.float32)
+    assert wire < x.size * 4 / 6
+
+
+def test_powersgd_roundtrip_and_rank(x):
+    comp = PowerSGDCompressor(rank=4)
+    p = comp.compress(x)
+    y = comp.decompress(p)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    assert np.linalg.matrix_rank(np.asarray(y)) <= 4
+    # a rank-2 matrix is reconstructed (nearly) exactly at rank >= 2
+    rng = np.random.default_rng(1)
+    lowrank = jnp.asarray(
+        rng.normal(size=(30, 2)) @ rng.normal(size=(2, 20)), jnp.float32
+    )
+    y2 = comp.decompress(comp.compress(lowrank))
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(lowrank), atol=1e-3)
+    # 1-D leaves pass through exactly
+    v = jnp.arange(7.0)
+    assert comp.decompress(comp.compress(v)) is v
+
+
+def test_stochastic_compress_tree_requires_rng(x):
+    with pytest.raises(ValueError, match="rng"):
+        RandomKCompressor(ratio=0.5).compress_tree({"a": x})
+
+
+# ---------------------------------------------------------------------------
+# end-to-end gossip with the new codecs
+# ---------------------------------------------------------------------------
+
+
+def _train(compressor, rounds=30, world=4, gamma=0.4):
+    topo = RingTopology(world)
+    model = MLP(hidden=16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo, compressor=compressor, gamma=gamma),
+        optimizer=optax.adam(2e-3),
+        h=1,
+    )
+    init = lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+    data = SyntheticClassification(n=1024)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state = init_stacked_state(cfg, init, jax.random.key(0), world)
+    losses = []
+    for batch in round_batches(data, world, h=1, batch=32, rounds=rounds):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return losses, state
+
+
+@pytest.mark.parametrize(
+    "comp",
+    [
+        RandomKCompressor(ratio=0.25),
+        QSGDCompressor(chunk=128),
+        SignCompressor(chunk=128),
+        PowerSGDCompressor(rank=2),
+    ],
+    ids=["randomk", "qsgd", "sign", "powersgd"],
+)
+def test_choco_converges_with_codec(comp):
+    losses, _ = _train(comp)
+    assert losses[-1] < 0.6 * losses[0], f"no convergence: {losses[0]} -> {losses[-1]}"
+    assert all(np.isfinite(losses))
+
+
+def test_stochastic_codec_backends_agree():
+    """Random-k gossip must produce identical trajectories on the collective
+    and simulated backends (same per-worker rng -> same random indices)."""
+    topo = RingTopology(4)
+    model = MLP(hidden=16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(
+            topology=topo, compressor=RandomKCompressor(ratio=0.5), gamma=0.5
+        ),
+        optimizer=optax.sgd(0.05, momentum=0.9),
+        h=2,
+    )
+    init = lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+    data = SyntheticClassification(n=256)
+    wmesh = WorkerMesh.create(topo, devices=jax.devices()[:4])
+    step_c = make_collective_train_step(cfg, mlp_loss_fn(model), wmesh)
+    step_s = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state_c = wmesh.shard_stacked(init_stacked_state(cfg, init, jax.random.key(0), 4))
+    state_s = init_stacked_state(cfg, init, jax.random.key(0), 4)
+    for batch in round_batches(data, 4, h=2, batch=16, rounds=3):
+        state_c, m_c = step_c(state_c, wmesh.shard_stacked(batch))
+        state_s, m_s = step_s(state_s, batch)
+    for a, b in zip(jax.tree.leaves(state_c.params), jax.tree.leaves(state_s.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# filtered compression (LoRA pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_filtered_compressed_gossip():
+    """Compressor + path_filter: only adapter-like leaves are gossiped
+    (compressed), frozen leaves stay bit-identical, and CHOCO state covers
+    only the filtered leaves."""
+    topo = RingTopology(4)
+    flt = lambda path: any(getattr(k, "key", None) == "adapter" for k in path)
+    cfg_g = GossipConfig(
+        topology=topo, compressor=QSGDCompressor(chunk=64), gamma=0.6, path_filter=flt
+    )
+    from consensusml_tpu.consensus import ConsensusEngine
+
+    engine = ConsensusEngine(cfg_g)
+    rng = np.random.default_rng(0)
+    params = {
+        "adapter": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32),
+        "frozen": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32),
+    }
+    state = engine.init_state(params)
+    assert len(jax.tree.leaves(state.xhat)) == 1  # adapters only
+
+    w = jnp.asarray(topo.mixing_matrix(), jnp.float32)
+    keys = jax.random.split(jax.random.key(7), 4)
+    mixed, state = engine.round_simulated(params, state, w, rng=keys)
+    np.testing.assert_array_equal(
+        np.asarray(mixed["frozen"]), np.asarray(params["frozen"])
+    )
+    assert not np.allclose(np.asarray(mixed["adapter"]), np.asarray(params["adapter"]))
+
+    # repeated rounds contract adapter disagreement
+    disagreement = lambda t: float(
+        jnp.sqrt(jnp.mean(jnp.sum((t - jnp.mean(t, 0, keepdims=True)) ** 2, (1, 2))))
+    )
+    d0 = disagreement(params["adapter"])
+    cur = mixed
+    for i in range(20):
+        keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, i)
+        cur, state = engine.round_simulated(cur, state, w, rng=keys)
+    assert disagreement(cur["adapter"]) < 0.2 * d0
