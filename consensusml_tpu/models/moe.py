@@ -1,0 +1,236 @@
+"""Mixture-of-Experts decoder with expert-parallel (EP) sharding support.
+
+The reference's model zoo is dense-only (BASELINE.json configs; SURVEY.md
+L5 — mount empty, no MoE evidence), but its decentralized-bandwidth story
+(compress what rides the wire) extends naturally to sparse models, and EP
+completes the framework's parallelism axes (gossip-DP x {TP, SP, EP}).
+
+TPU-first routing design: capacity-based top-k dispatch with STATIC shapes
+throughout — every token is routed via one-hot dispatch/combine tensors and
+the expert FFN is one batched einsum over a leading expert axis ``(E, d,
+f)``, so XLA tiles it onto the MXU and, when ``E`` is sharded over an
+``ep`` mesh axis (:func:`consensusml_tpu.parallel.moe_ep_rules`), inserts
+the dispatch all-to-alls itself. No sorting, no ragged buffers, no
+host-side routing — the GShard/Switch recipe expressed as pure einsums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.attention import (
+    apply_rope,
+    dot_product_attention,
+    rope_frequencies,
+)
+from consensusml_tpu.models.losses import masked_lm_loss
+
+__all__ = ["MoEConfig", "MoELM", "moe_tiny", "moe_loss_fn", "top_k_routing"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden: int = 1024
+    layers: int = 8
+    heads: int = 8
+    mlp_dim: int = 4096
+    n_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    moe_every: int = 2  # every Nth block is MoE (GShard interleave); 1 = all
+    max_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def moe_tiny(**overrides) -> "MoELM":
+    """Test-scale MoE (same code path, tiny dims)."""
+    defaults = dict(
+        vocab_size=256,
+        hidden=32,
+        layers=2,
+        heads=2,
+        mlp_dim=64,
+        n_experts=4,
+        expert_top_k=2,
+        moe_every=1,
+        max_len=64,
+    )
+    defaults.update(overrides)
+    return MoELM(config=MoEConfig(**defaults))
+
+
+def top_k_routing(
+    probs: jax.Array, k: int, capacity: int
+) -> tuple[jax.Array, jax.Array]:
+    """Static-shape top-k token-choice routing with expert capacity.
+
+    ``probs``: router softmax ``(B, S, E)`` (f32). Returns
+    ``(dispatch, combine)``, both ``(B, S, E, C)``: ``dispatch`` is the 0/1
+    token->(expert, slot) assignment, ``combine`` carries the (renormalized)
+    gate weights. Assignment priority is slot-major — every token's first
+    choice claims capacity before any second choice — and within a slot,
+    sequence order (the deterministic GShard tie-break). Tokens overflowing
+    an expert's capacity are dropped from that expert (their combine weight
+    is zero), the standard capacity-factor contract.
+    """
+    b, s, e = probs.shape
+    p = probs
+    masks, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(p, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # (B, S, E)
+        gates.append(jnp.sum(p * m, axis=-1))  # (B, S)
+        masks.append(m)
+        p = p * (1.0 - m)
+    denom = sum(gates) + 1e-9  # renormalize the k kept gates per token
+    pos, offset = [], jnp.zeros((b, 1, e), probs.dtype)
+    for m in masks:
+        pos.append(jnp.cumsum(m, axis=1) - m + offset)  # tokens ahead of me
+        offset = offset + jnp.sum(m, axis=1, keepdims=True)
+    dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
+    combine = jnp.zeros((b, s, e, capacity), probs.dtype)
+    for m, g, pp in zip(masks, gates, pos):
+        keep = m * (pp < capacity)  # (B, S, E)
+        slot = keep[..., None] * jax.nn.one_hot(
+            pp.astype(jnp.int32), capacity, dtype=probs.dtype
+        )  # (B, S, E, C)
+        dispatch = dispatch + slot
+        combine = combine + (g / denom)[..., None, None] * slot
+    return dispatch, combine
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN; returns ``(y, aux_loss)``.
+
+    Expert weights are stacked on a leading expert axis — ``wi (E, d, f)``,
+    ``wo (E, f, d)`` — the layout :func:`~consensusml_tpu.parallel.
+    moe_ep_rules` shards over the ``ep`` mesh axis. Router runs in f32.
+    ``aux_loss`` is the Switch/GShard load-balance term: ``E * sum_e
+    (token_fraction_e * mean_router_prob_e)`` — 1.0 at perfect balance.
+    """
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        c = self.config
+        b, s, d = x.shape
+        e, k = c.n_experts, c.expert_top_k
+        capacity = max(1, int(-(-s * k * c.capacity_factor // e)))
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="router",
+        )(jnp.asarray(x, jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+        dispatch, combine = top_k_routing(probs, k, capacity)
+
+        me = jnp.mean(probs, axis=(0, 1))  # mean router prob per expert
+        ce = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1)) / k  # tok frac
+        aux = e * jnp.sum(me * ce)
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (e, d, c.mlp_dim), jnp.float32
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (e, c.mlp_dim, d), jnp.float32
+        )
+        xin = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(c.dtype), jnp.asarray(x, c.dtype)
+        )
+        h = nn.gelu(
+            jnp.einsum(
+                "ebcd,edf->ebcf", xin, wi.astype(c.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(c.dtype)
+        )
+        out = jnp.einsum(
+            "ebcf,efd->ebcd", h, wo.astype(c.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(jnp.float32), out)
+        return y.astype(x.dtype), aux
+
+
+class _MoEBlock(nn.Module):
+    config: MoEConfig
+    use_moe: bool
+
+    @nn.compact
+    def __call__(self, x, rope_table):
+        c = self.config
+        d = c.head_dim
+        y = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32, name="attn_norm")(x)
+        y = jnp.asarray(y, c.dtype)
+        b, s, _ = y.shape
+        qkv = nn.Dense(3 * c.heads * d, use_bias=False, dtype=c.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, c.heads, 3 * d), 3, axis=-1)
+        q = apply_rope(q, rope_table)
+        k = apply_rope(k, rope_table)
+        attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
+        x = x + nn.Dense(c.hidden, use_bias=False, dtype=c.dtype, name="out")(
+            attn.reshape(b, s, c.heads * d)
+        )
+        y = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32, name="mlp_norm")(x)
+        y = jnp.asarray(y, c.dtype)
+        if self.use_moe:
+            y, aux = MoEMLP(c, name="moe")(y)
+        else:
+            h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_in")(y))
+            y = nn.Dense(c.hidden, dtype=c.dtype, name="mlp_out")(h)
+            aux = jnp.zeros((), jnp.float32)
+        return x + y, aux
+
+
+class MoELM(nn.Module):
+    """Decoder-only LM with interleaved MoE blocks.
+
+    ``apply`` returns ``(logits (B, S, V) f32, aux_loss scalar f32)`` —
+    ``aux_loss`` is the mean load-balance loss over MoE blocks, to be added
+    to the task loss with weight ``config.router_aux_weight`` (done by
+    :func:`moe_loss_fn`).
+    """
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
+        rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
+        aux_total, n_moe = jnp.zeros((), jnp.float32), 0
+        for i in range(c.layers):
+            use_moe = (i % c.moe_every) == (c.moe_every - 1)
+            x, aux = _MoEBlock(c, use_moe, name=f"layer_{i}")(x, rope_table)
+            aux_total, n_moe = aux_total + aux, n_moe + int(use_moe)
+        x = nn.LayerNorm(epsilon=c.norm_eps, dtype=jnp.float32, name="final_norm")(x)
+        logits = nn.Dense(
+            c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head"
+        )(jnp.asarray(x, c.dtype))
+        return jnp.asarray(logits, jnp.float32), aux_total / max(n_moe, 1)
+
+
+def moe_loss_fn(model: MoELM):
+    """Causal LM loss + weighted router load-balance aux loss."""
+
+    def loss_fn(params, model_state, batch, rng):
+        ids = batch["input_ids"]
+        logits, aux = model.apply({"params": params}, ids)
+        mask = batch.get("loss_mask")
+        mask = jnp.ones_like(ids[:, 1:], jnp.float32) if mask is None else mask[:, 1:]
+        lm = masked_lm_loss(logits[:, :-1], ids[:, 1:], mask)
+        return lm + model.config.router_aux_weight * aux, model_state
+
+    return loss_fn

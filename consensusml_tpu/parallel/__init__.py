@@ -15,5 +15,6 @@ from consensusml_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,
     gpt2_tp_rules,
     llama_tp_rules,
+    moe_ep_rules,
     spec_for_path,
 )

@@ -30,6 +30,7 @@ __all__ = [
     "spec_for_path",
     "llama_tp_rules",
     "gpt2_tp_rules",
+    "moe_ep_rules",
 ]
 
 # (path regex, trailing-dim partition spec) — axis names must exist on the
@@ -101,6 +102,17 @@ def llama_tp_rules(axis: str = "tp") -> ShardingRules:
         (r"down_proj/kernel", (axis, None)),
         (r"lm_head/kernel", (None, axis)),
         (r"tok_emb/embedding", (None, axis)),
+    ]
+
+
+def moe_ep_rules(axis: str = "ep") -> ShardingRules:
+    """Expert parallelism for :class:`~consensusml_tpu.models.moe.MoELM`:
+    the stacked expert weights ``wi (E, d, f)`` / ``wo (E, f, d)`` split
+    their leading expert dim over ``axis``; the router and the dense
+    (attention/shared-MLP) weights stay replicated. XLA's auto mode derives
+    the dispatch/combine all-to-alls from these annotations."""
+    return [
+        (r"moe/w[io]", (axis, None, None)),
     ]
 
 
