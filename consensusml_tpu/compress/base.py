@@ -21,7 +21,17 @@ __all__ = [
     "Int8Payload",
     "IdentityCompressor",
     "ComposedCompressor",
+    "static_k",
 ]
+
+
+def static_k(size: int, ratio: float, k: int | None) -> int:
+    """Resolve the static per-tensor k: explicit ``k`` wins, else
+    ``round(ratio * size)``, clamped to ``[1, size]``. One policy shared by
+    every sparsifying codec so they agree on k for the same ratio."""
+    if k is not None:
+        return max(1, min(k, size))
+    return max(1, min(size, int(round(size * ratio))))
 
 
 @jax.tree_util.register_pytree_node_class
@@ -156,6 +166,12 @@ class ComposedCompressor(Compressor):
         return self.inner.stochastic or self.outer.stochastic
 
     def compress(self, x: jax.Array, rng: jax.Array | None = None):
+        if self.stochastic and rng is None:
+            raise ValueError(
+                f"{type(self).__name__} is stochastic (inner="
+                f"{type(self.inner).__name__}, outer="
+                f"{type(self.outer).__name__}) and needs an rng"
+            )
         sub = lambda c, tag: (
             {"rng": jax.random.fold_in(rng, tag)} if c.stochastic else {}
         )

@@ -34,6 +34,12 @@ from consensusml_tpu.compress.base import (
     Compressor,
     Int8Payload,
     TopKPayload,
+    static_k as _static_k,
+)
+from consensusml_tpu.compress.reference import (
+    Int8Compressor,
+    TopKCompressor,
+    chunk_for_quantization,
 )
 
 __all__ = [
@@ -46,14 +52,8 @@ __all__ = [
 ]
 
 
-def _static_k(size: int, ratio: float, k: int | None) -> int:
-    if k is not None:
-        return max(1, min(k, size))
-    return max(1, min(size, int(round(size * ratio))))
-
-
 @dataclasses.dataclass(frozen=True)
-class RandomKCompressor(Compressor):
+class RandomKCompressor(TopKCompressor):
     """Keep k uniformly-random coordinates; needs per-round rng.
 
     Default (``unbiased=False``) keeps raw values: a k/n-contraction,
@@ -62,10 +62,11 @@ class RandomKCompressor(Compressor):
     ``E[decompress(compress(x))] = x`` — useful for plain compressed
     all-reduce, but its error grows with n/k, so do NOT use it as a CHOCO
     codec (the consensus iteration amplifies non-contractive noise).
+
+    Inherits ``ratio``/``k`` resolution and the scatter ``decompress``
+    from :class:`TopKCompressor` — same payload, different selection.
     """
 
-    ratio: float = 0.01
-    k: int | None = None
     unbiased: bool = False
     stochastic = True
 
@@ -86,47 +87,27 @@ class RandomKCompressor(Compressor):
             values=vals.astype(flat.dtype), indices=idx, shape=x.shape, dtype=x.dtype
         )
 
-    def decompress(self, payload: TopKPayload) -> jax.Array:
-        n = math.prod(payload.shape)
-        flat = jnp.zeros((n,), payload.dtype)
-        flat = flat.at[payload.indices].set(jnp.asarray(payload.values, payload.dtype))
-        return flat.reshape(payload.shape)
-
 
 @dataclasses.dataclass(frozen=True)
-class QSGDCompressor(Compressor):
+class QSGDCompressor(Int8Compressor):
     """Per-chunk int8 with stochastic rounding: unbiased quantization.
 
-    Same wire format as :class:`Int8Compressor` (int8 + f32 chunk scales)
-    but ``q = floor(x/scale + u)``, ``u ~ U[0,1)``, so ``E[q*scale] = x``.
+    Same wire format as :class:`Int8Compressor` (int8 + f32 chunk scales,
+    whose ``decompress`` it inherits) but ``q = floor(x/scale + u)``,
+    ``u ~ U[0,1)``, so ``E[q*scale] = x``.
     """
 
-    chunk: int = 256
     stochastic = True
 
     def compress(self, x: jax.Array, rng: jax.Array | None = None) -> Int8Payload:
         if rng is None:
             raise ValueError("QSGDCompressor needs rng (stochastic codec)")
-        flat = jnp.asarray(x.reshape(-1), jnp.float32)
-        n = flat.size
-        chunk = min(self.chunk, n)
-        pad = (-n) % chunk
-        padded = jnp.pad(flat, (0, pad))
-        chunks = padded.reshape(-1, chunk)
-        absmax = jnp.max(jnp.abs(chunks), axis=1)
-        scales = absmax / 127.0
-        inv = jnp.where(scales > 0, 1.0 / jnp.where(scales > 0, scales, 1.0), 0.0)
+        chunks, scales, inv, chunk = chunk_for_quantization(x, self.chunk)
         u = jax.random.uniform(rng, chunks.shape)
         q = jnp.clip(jnp.floor(chunks * inv[:, None] + u), -127, 127).astype(jnp.int8)
         return Int8Payload(
             data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk
         )
-
-    def decompress(self, payload: Int8Payload) -> jax.Array:
-        chunks = payload.data.reshape(-1, payload.chunk).astype(jnp.float32)
-        flat = (chunks * payload.scales[:, None]).reshape(-1)
-        n = math.prod(payload.shape)
-        return flat[:n].astype(payload.dtype).reshape(payload.shape)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -230,9 +211,9 @@ class PowerSGDCompressor(Compressor):
             return x  # raw passthrough payload
         mat = jnp.asarray(x.reshape(x.shape[0], -1), jnp.float32)
         n, m = mat.shape
-        r = min(self.rank, n, m)
-        if min(n, m) <= r:
-            return x
+        if min(n, m) <= self.rank:
+            return x  # factors would be no smaller than the tensor
+        r = self.rank
         q0 = jax.random.normal(jax.random.key(n * 1_000_003 + m), (m, r), jnp.float32)
         p = mat @ q0
         # orthonormalize via QR (r is tiny; cost is negligible)

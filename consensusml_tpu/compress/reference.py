@@ -14,15 +14,34 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from consensusml_tpu.compress.base import Compressor, Int8Payload, TopKPayload
+from consensusml_tpu.compress.base import (
+    Compressor,
+    Int8Payload,
+    TopKPayload,
+    static_k as _static_k,
+)
 
 __all__ = ["TopKCompressor", "Int8Compressor", "topk_int8_compressor"]
 
 
-def _static_k(size: int, ratio: float, k: int | None) -> int:
-    if k is not None:
-        return max(1, min(k, size))
-    return max(1, min(size, int(round(size * ratio))))
+def chunk_for_quantization(x: jax.Array, chunk: int):
+    """Shared int8-wire-format front end: flatten, clamp the chunk to the
+    tensor, zero-pad, and compute per-chunk symmetric scales. Returns
+    ``(chunks (C, chunk) f32, scales (C,) f32, inv (C,) f32, chunk)`` —
+    the ONE definition of the chunked-int8 layout, used by every codec
+    that produces an :class:`Int8Payload`."""
+    flat = jnp.asarray(x.reshape(-1), jnp.float32)
+    n = flat.size
+    # effective chunk never exceeds the tensor: small leaves (biases,
+    # top-k value vectors with k < chunk) must not balloon to a full
+    # zero-padded chunk on the wire
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+    absmax = jnp.max(jnp.abs(chunks), axis=1)
+    scales = absmax / 127.0
+    inv = jnp.where(scales > 0, 1.0 / jnp.where(scales > 0, scales, 1.0), 0.0)
+    return chunks, scales, inv, chunk
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,18 +88,7 @@ class Int8Compressor(Compressor):
     chunk: int = 256
 
     def compress(self, x: jax.Array) -> Int8Payload:
-        flat = jnp.asarray(x.reshape(-1), jnp.float32)
-        n = flat.size
-        # effective chunk never exceeds the tensor: small leaves (biases,
-        # top-k value vectors with k < chunk) must not balloon to a full
-        # zero-padded chunk on the wire
-        chunk = min(self.chunk, n)
-        pad = (-n) % chunk
-        padded = jnp.pad(flat, (0, pad))
-        chunks = padded.reshape(-1, chunk)
-        absmax = jnp.max(jnp.abs(chunks), axis=1)
-        scales = absmax / 127.0
-        inv = jnp.where(scales > 0, 1.0 / jnp.where(scales > 0, scales, 1.0), 0.0)
+        chunks, scales, inv, chunk = chunk_for_quantization(x, self.chunk)
         q = jnp.clip(jnp.rint(chunks * inv[:, None]), -127, 127).astype(jnp.int8)
         return Int8Payload(
             data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk

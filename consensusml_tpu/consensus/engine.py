@@ -227,28 +227,21 @@ class ConsensusEngine:
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         delta = jax.tree.map(jnp.subtract, x, state.xhat)
-        # per-worker compress; leaf index folds into the key exactly like
-        # Compressor.compress_tree does on the collective side
-        leaves, treedef = jax.tree.flatten(delta)
+        # vmap the SAME compress_tree/decompress_tree path the collective
+        # backend runs, so the per-leaf rng fold-in convention has one
+        # source of truth and the backends draw identical randomness
         if comp.stochastic:
             if rng is None:
                 raise ValueError(
                     f"{type(comp).__name__} is stochastic and needs stacked rng"
                 )
-            dec_leaves = [
-                jax.vmap(
-                    lambda v, k, i=i: comp.decompress(
-                        comp.compress(v, rng=jax.random.fold_in(k, i))
-                    )
-                )(d, rng)
-                for i, d in enumerate(leaves)
-            ]
+            dec_q = jax.vmap(
+                lambda t, k: comp.decompress_tree(comp.compress_tree(t, k), like=t)
+            )(delta, rng)
         else:
-            dec_leaves = [
-                jax.vmap(lambda v: comp.decompress(comp.compress(v)))(d)
-                for d in leaves
-            ]
-        dec_q = jax.tree.unflatten(treedef, dec_leaves)
+            dec_q = jax.vmap(
+                lambda t: comp.decompress_tree(comp.compress_tree(t), like=t)
+            )(delta)
         xhat = jax.tree.map(jnp.add, state.xhat, dec_q)
         recv = simulated.mix_tree_stacked(dec_q, w)
         s = jax.tree.map(jnp.add, state.s, recv)
