@@ -20,12 +20,25 @@ import numpy as np
 
 from consensusml_tpu.topology import Topology
 
-__all__ = ["mixing_matrix", "mix_stacked", "mix_tree_stacked", "consensus_error_stacked"]
+__all__ = [
+    "mixing_matrix",
+    "phase_matrices",
+    "mix_stacked",
+    "mix_tree_stacked",
+    "consensus_error_stacked",
+]
 
 
 def mixing_matrix(topology: Topology, dtype=jnp.float32) -> jax.Array:
     """The topology's mixing matrix as a device array (flat worker order)."""
     return jnp.asarray(np.asarray(topology.mixing_matrix()), dtype=dtype)
+
+
+def phase_matrices(topology: Topology, dtype=jnp.float32) -> jax.Array:
+    """``(period, n, n)`` stacked matrices of a time-varying topology; round
+    ``t`` uses index ``t % period`` — the simulated-backend counterpart of
+    the collective backend's ``lax.switch`` phase dispatch."""
+    return jnp.asarray(topology.phase_matrices(), dtype=dtype)
 
 
 def mix_stacked(x: jax.Array, w: jax.Array) -> jax.Array:

@@ -21,6 +21,7 @@ configs (dense/ring/torus averaging) and the compressed config
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -63,6 +64,16 @@ class GossipConfig:
                 "CHOCO's xhat tracking assumes every peer applies every "
                 "innovation, which a dropped round violates; use exact "
                 "gossip with faults, or compression without faults"
+            )
+        if self.faults is not None and not self.topology.symmetric:
+            raise NotImplementedError(
+                "fault masking requires a SYMMETRIC topology: folding a "
+                "dead peer's weight onto self keeps W doubly stochastic "
+                "(mean-preserving) only when W = W^T; a directed graph "
+                f"({self.topology.name}) would bias the network mean each "
+                "faulty round. Use ring/torus/dense/exp with faults, or a "
+                "directed topology without faults (push-sum averaging "
+                "would lift this restriction)"
             )
 
 
@@ -119,14 +130,41 @@ class ConsensusEngine:
         state: ChocoState | None,
         alive: jax.Array | None = None,
         rng: jax.Array | None = None,
+        step: jax.Array | None = None,
     ):
         """One gossip round, per-worker view. Returns (params, state).
 
         ``alive`` (scalar 0/1, only with ``config.faults``): this worker's
         participation flag — see :mod:`consensusml_tpu.consensus.faults`.
         ``rng``: this worker's key for stochastic codecs (random-k, QSGD).
+        ``step``: round counter (required for time-varying topologies —
+        selects the phase via ``lax.switch``; every worker holds the same
+        count, so all branches agree across the mesh).
         """
         topo = self.topology
+        if not topo.is_time_varying:
+            return self._phase_collective(topo, params, state, alive, rng)
+        if step is None:
+            raise ValueError(
+                f"{type(topo).__name__} is time-varying: round_collective "
+                "needs the round counter (step=...)"
+            )
+        branches = [
+            functools.partial(self._phase_collective, phase)
+            for phase in topo.phases
+        ]
+        return jax.lax.switch(
+            step % topo.period, branches, params, state, alive, rng
+        )
+
+    def _phase_collective(
+        self,
+        topo: Topology,
+        params: Any,
+        state: ChocoState | None,
+        alive: jax.Array | None,
+        rng: jax.Array | None,
+    ):
         if not self.compressed:
             flt = self.config.path_filter
             if alive is not None:

@@ -14,8 +14,11 @@ empty, see SURVEY.md). Here a topology is pure math: it yields
 
 from consensusml_tpu.topology.topologies import (  # noqa: F401
     DenseTopology,
+    ExponentialTopology,
+    OnePeerExponentialTopology,
     RingTopology,
     Shift,
+    TimeVaryingTopology,
     Topology,
     TorusTopology,
     topology_from_name,

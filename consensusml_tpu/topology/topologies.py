@@ -33,6 +33,9 @@ __all__ = [
     "RingTopology",
     "TorusTopology",
     "DenseTopology",
+    "ExponentialTopology",
+    "TimeVaryingTopology",
+    "OnePeerExponentialTopology",
     "topology_from_name",
 ]
 
@@ -53,7 +56,10 @@ class Shift:
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """Base: a weighted, symmetric, connected gossip graph on a mesh."""
+    """Base: a weighted, doubly-stochastic, connected gossip graph on a
+    mesh. Undirected graphs (ring/torus/dense/exp) have symmetric ``W``;
+    directed ones (one-peer exponential phases) are doubly stochastic but
+    asymmetric — see :attr:`symmetric`."""
 
     mesh_shape: tuple[int, ...]
     axis_names: tuple[str, ...]
@@ -106,18 +112,41 @@ class Topology:
                 w[i, j] += wt
         return w
 
-    def spectral_gap(self) -> float:
-        """``1 - |lambda_2(W)|``: the per-round consensus contraction rate.
+    @property
+    def symmetric(self) -> bool:
+        """True when the mixing matrix equals its transpose (undirected
+        graph). One-peer phases are directed (doubly stochastic but not
+        symmetric); fault masking currently requires symmetry to preserve
+        the network mean."""
+        w = self.mixing_matrix()
+        return bool(np.allclose(w, w.T, atol=1e-12))
 
-        Positive gap <=> gossip converges geometrically to consensus.
+    def spectral_gap(self) -> float:
+        """Per-round consensus contraction rate.
+
+        Symmetric ``W``: ``1 - |lambda_2|`` via eigvalsh. Directed doubly
+        stochastic ``W`` (one-peer phases): eigvalsh would silently
+        symmetrize, so use the operator norm of ``W`` restricted to the
+        disagreement subspace, ``1 - ||W - 11^T/n||_2`` — the tight
+        worst-case contraction either way.
         """
-        # W is symmetric by construction -> eigvalsh (real, sorted, stable)
-        eig = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix())))
-        return float(1.0 - eig[-2]) if len(eig) > 1 else 1.0
+        w = self.mixing_matrix()
+        n = w.shape[0]
+        if n < 2:
+            return 1.0
+        if np.allclose(w, w.T, atol=1e-12):
+            eig = np.sort(np.abs(np.linalg.eigvalsh(w)))
+            return float(1.0 - eig[-2])
+        return float(1.0 - np.linalg.norm(w - np.full((n, n), 1.0 / n), 2))
 
     @property
     def uses_psum(self) -> bool:
         """Dense topologies lower to one pmean instead of ppermute shifts."""
+        return False
+
+    @property
+    def is_time_varying(self) -> bool:
+        """True when the mixing operator depends on the round index."""
         return False
 
 
@@ -209,18 +238,171 @@ class DenseTopology(Topology):
         return True
 
 
+def _exp_offsets(n: int) -> list[int]:
+    """Unique non-zero power-of-two cyclic offsets modulo ``n``."""
+    offs: set[int] = set()
+    p = 1
+    while p < n:
+        offs.add(p % n)
+        p *= 2
+    offs.discard(0)
+    return sorted(offs)
+
+
+class ExponentialTopology(Topology):
+    """Static exponential graph: neighbors at cyclic offsets ``±2^p``.
+
+    The undirected exponential graph has diameter ``O(log n)`` with only
+    ``O(log n)`` neighbors per worker, so its spectral gap decays like
+    ``1/log n`` instead of the ring's ``1/n^2`` — near-dense mixing at a
+    logarithmic communication cost. The edge set {±2^p mod n} is closed
+    under negation, so ``W`` is symmetric and :meth:`Topology.spectral_gap`
+    applies. No reference-parity citation: BASELINE.json names only
+    ring/torus/dense (mount empty); this topology is an addition enabled
+    by how cheap extra ``ppermute`` edges are on ICI.
+    """
+
+    def __init__(self, world_size: int, axis_name: str = "workers"):
+        n = world_size
+        if n < 1:
+            raise ValueError(f"world_size must be positive, got {n}")
+        offs: set[int] = set()
+        for o in _exp_offsets(n):
+            offs.update((o, (n - o) % n))
+        offs.discard(0)
+        degree = len(offs)
+        w = 1.0 / (degree + 1) if degree else 0.0
+        shifts = tuple(Shift(0, o, w) for o in sorted(offs))
+        super().__init__(
+            mesh_shape=(n,),
+            axis_names=(axis_name,),
+            shifts=shifts,
+            self_weight=1.0 - degree * w if degree else 1.0,
+            name="exp",
+        )
+
+
+class TimeVaryingTopology(Topology):
+    """A periodic schedule of per-round topologies on one mesh.
+
+    Round ``t`` applies ``phases[t % period]``. The collective backend
+    dispatches with ``lax.switch`` (each branch's ppermutes keep static
+    perms); the simulated backend indexes a stacked array of per-phase
+    mixing matrices. Every phase must share the mesh shape and axis names.
+    """
+
+    def __init__(self, phases: Sequence[Topology], name: str = "time-varying"):
+        phases = tuple(phases)
+        if not phases:
+            raise ValueError("TimeVaryingTopology needs at least one phase")
+        ms, an = phases[0].mesh_shape, phases[0].axis_names
+        for p in phases:
+            if p.mesh_shape != ms or p.axis_names != an:
+                raise ValueError(
+                    f"all phases must share mesh_shape/axis_names; got "
+                    f"{p.mesh_shape}/{p.axis_names} vs {ms}/{an}"
+                )
+            if p.is_time_varying:
+                raise ValueError("phases cannot themselves be time-varying")
+        super().__init__(
+            mesh_shape=ms, axis_names=an, shifts=(), self_weight=1.0, name=name
+        )
+        object.__setattr__(self, "phases", phases)
+
+    @property
+    def is_time_varying(self) -> bool:
+        return True
+
+    @property
+    def symmetric(self) -> bool:
+        return all(p.symmetric for p in self.phases)
+
+    @property
+    def period(self) -> int:
+        return len(self.phases)
+
+    def phase_matrices(self) -> np.ndarray:
+        """``(period, n, n)`` stacked per-phase mixing matrices."""
+        return np.stack([p.mixing_matrix() for p in self.phases])
+
+    def effective_matrix(self) -> np.ndarray:
+        """One full period's operator ``W_{P-1} @ ... @ W_0``."""
+        out = np.eye(self.world_size)
+        for w in self.phase_matrices():
+            out = w @ out
+        return out
+
+    def mixing_matrix(self) -> np.ndarray:
+        raise ValueError(
+            "time-varying topology has no single mixing matrix; use "
+            "phase_matrices() (per round) or effective_matrix() (per period)"
+        )
+
+    def spectral_gap(self) -> float:
+        """Per-PERIOD contraction: ``1 - ||W_eff - 11^T/n||_2``.
+
+        The phase matrices need not be symmetric (one-peer graphs are
+        directed), so this uses the operator norm of the effective matrix
+        on the disagreement subspace rather than eigenvalues.
+        """
+        n = self.world_size
+        dev = self.effective_matrix() - np.full((n, n), 1.0 / n)
+        return float(1.0 - np.linalg.norm(dev, 2))
+
+
+class OnePeerExponentialTopology(TimeVaryingTopology):
+    """One-peer exponential gossip: round ``t`` averages with the single
+    peer at cyclic offset ``2^(t mod tau)``.
+
+    Each round moves only ONE ppermute payload per worker (the cheapest
+    possible gossip round), yet for ``n = 2^tau`` the product of one
+    period's matrices is EXACTLY ``11^T/n`` — perfect consensus every
+    ``tau`` rounds, a finite-time guarantee no static graph of any degree
+    can match (Assran et al. 2019, SGP; Ying et al. 2021, exponential
+    graphs). For other ``n`` the phases remain doubly stochastic and the
+    contraction is geometric rather than exact.
+    """
+
+    def __init__(self, world_size: int, axis_name: str = "workers"):
+        n = world_size
+        if n < 1:
+            raise ValueError(f"world_size must be positive, got {n}")
+        offsets = _exp_offsets(n) or [0]
+        phases = [
+            Topology(
+                mesh_shape=(n,),
+                axis_names=(axis_name,),
+                shifts=(Shift(0, o, 0.5),) if o else (),
+                self_weight=0.5 if o else 1.0,
+                name=f"onepeer-exp[{o}]",
+            )
+            for o in offsets
+        ]
+        super().__init__(phases, name="onepeer-exp")
+
+
 def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:
-    """Build a topology from a CLI-style name: ring | torus | dense.
+    """Build a topology from a CLI-style name:
+    ring | torus | dense | exp (static exponential graph) |
+    onepeer-exp (time-varying one-peer exponential).
 
     For ``torus``, pass ``rows``/``cols`` or let it factor ``world_size``
     into the squarest grid."""
     name = name.lower()
     if world_size < 1:
         raise ValueError(f"world_size must be positive, got {world_size}")
-    if name in ("ring", "dense"):
+    simple = {
+        "ring": RingTopology,
+        "dense": DenseTopology,
+        "exp": ExponentialTopology,
+        "exponential": ExponentialTopology,
+        "onepeer-exp": OnePeerExponentialTopology,
+        "one-peer-exp": OnePeerExponentialTopology,
+    }
+    if name in simple:
         if kwargs:
             raise ValueError(f"{name} topology takes no extra args, got {sorted(kwargs)}")
-        return RingTopology(world_size) if name == "ring" else DenseTopology(world_size)
+        return simple[name](world_size)
     if name == "torus":
         if unknown := set(kwargs) - {"rows", "cols"}:
             raise ValueError(f"torus topology got unknown args {sorted(unknown)}")
@@ -241,4 +423,6 @@ def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:
         if rows * cols != world_size:
             raise ValueError(f"torus {rows}x{cols} != world_size {world_size}")
         return TorusTopology(rows, cols)
-    raise ValueError(f"unknown topology {name!r} (expected ring|torus|dense)")
+    raise ValueError(
+        f"unknown topology {name!r} (expected ring|torus|dense|exp|onepeer-exp)"
+    )

@@ -264,7 +264,8 @@ def make_collective_train_step(
         else:
             gsub = None
         mixed, gossip = engine.round_collective(
-            _gossiped(params, model_state), state.gossip, alive, gsub
+            _gossiped(params, model_state), state.gossip, alive, gsub,
+            step=state.step,
         )
         params, model_state = mixed["params"], mixed["model_state"]
         err = engine.consensus_error_collective(params)
@@ -319,7 +320,12 @@ def make_simulated_train_step(
     """
     engine = cfg.engine()
     topo = cfg.gossip.topology
-    w = simulated.mixing_matrix(topo)
+    # time-varying topologies: stack per-phase matrices once, index by round
+    w_all = (
+        simulated.phase_matrices(topo)
+        if topo.is_time_varying
+        else simulated.mixing_matrix(topo)
+    )
     faults = cfg.gossip.faults
     comp = cfg.gossip.compressor
     stochastic_comp = comp is not None and comp.stochastic
@@ -362,6 +368,9 @@ def make_simulated_train_step(
             )(jax.vmap(jax.random.split)(rng))
         else:
             gsub = None
+        w = (
+            w_all[state.step[0] % topo.period] if topo.is_time_varying else w_all
+        )
         mixed, gossip = engine.round_simulated(
             _gossiped(params, model_state), state.gossip, w, alive, gsub
         )

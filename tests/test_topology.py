@@ -10,6 +10,8 @@ import pytest
 
 from consensusml_tpu.topology import (
     DenseTopology,
+    ExponentialTopology,
+    OnePeerExponentialTopology,
     RingTopology,
     TorusTopology,
     topology_from_name,
@@ -26,6 +28,10 @@ TOPOLOGIES = [
     TorusTopology(1, 8),
     DenseTopology(4),
     DenseTopology(32),
+    ExponentialTopology(2),
+    ExponentialTopology(6),
+    ExponentialTopology(8),
+    ExponentialTopology(32),
 ]
 
 
@@ -128,5 +134,65 @@ def test_from_name():
     assert topology_from_name("dense", 4).uses_psum
     t = topology_from_name("torus", 16)
     assert t.mesh_shape == (4, 4)
+    assert topology_from_name("exp", 16).name == "exp"
+    assert topology_from_name("onepeer-exp", 16).is_time_varying
     with pytest.raises(ValueError):
         topology_from_name("hypercube", 8)
+
+
+# ---------------------------------------------------------------------------
+# exponential / time-varying topologies
+# ---------------------------------------------------------------------------
+
+
+def test_exp_beats_ring_gap():
+    """log-n neighbors buy a far better spectral gap than the ring's."""
+    for n in (16, 32, 64):
+        assert ExponentialTopology(n).spectral_gap() > 5 * RingTopology(n).spectral_gap()
+
+
+def test_exp_neighbor_count_logarithmic():
+    topo = ExponentialTopology(64)
+    # offsets ±{1,2,4,8,16,32} with 32 self-paired -> 11 distinct neighbors
+    assert len(topo.neighbors(0)) == 11
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 6, 8, 16])
+def test_onepeer_phases_doubly_stochastic(n):
+    topo = OnePeerExponentialTopology(n)
+    for w in topo.phase_matrices():
+        np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+        np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+        assert (w >= -1e-12).all()
+
+
+@pytest.mark.parametrize("n", [2, 4, 8, 16, 32])
+def test_onepeer_exact_average_in_log_n_rounds(n):
+    """For n = 2^tau one period's product is EXACTLY the uniform average."""
+    topo = OnePeerExponentialTopology(n)
+    assert topo.period == int(np.log2(n))
+    np.testing.assert_allclose(
+        topo.effective_matrix(), np.full((n, n), 1.0 / n), atol=1e-12
+    )
+    assert topo.spectral_gap() == pytest.approx(1.0, abs=1e-9)
+
+
+def test_onepeer_non_power_of_two_still_contracts():
+    topo = OnePeerExponentialTopology(6)
+    assert topo.spectral_gap() > 0.3  # per-period contraction
+
+
+def test_time_varying_guards():
+    from consensusml_tpu.topology import TimeVaryingTopology
+
+    with pytest.raises(ValueError):
+        TimeVaryingTopology([])
+    with pytest.raises(ValueError):
+        TimeVaryingTopology([RingTopology(4), RingTopology(8)])
+    with pytest.raises(ValueError):
+        TimeVaryingTopology([OnePeerExponentialTopology(4)])  # nested TV
+    with pytest.raises(ValueError):
+        OnePeerExponentialTopology(8).mixing_matrix()  # no single matrix
+    # one-worker degenerate case: a single identity phase
+    solo = OnePeerExponentialTopology(1)
+    np.testing.assert_allclose(solo.effective_matrix(), np.eye(1))
