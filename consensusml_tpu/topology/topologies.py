@@ -282,6 +282,7 @@ class ExponentialTopology(Topology):
         )
 
 
+@dataclasses.dataclass(frozen=True, init=False)
 class TimeVaryingTopology(Topology):
     """A periodic schedule of per-round topologies on one mesh.
 
@@ -289,7 +290,12 @@ class TimeVaryingTopology(Topology):
     dispatches with ``lax.switch`` (each branch's ppermutes keep static
     perms); the simulated backend indexes a stacked array of per-phase
     mixing matrices. Every phase must share the mesh shape and axis names.
+
+    ``phases`` is a declared dataclass field so equality/hash distinguish
+    different schedules on the same mesh.
     """
+
+    phases: tuple[Topology, ...] = ()
 
     def __init__(self, phases: Sequence[Topology], name: str = "time-varying"):
         phases = tuple(phases)

@@ -20,3 +20,8 @@ from consensusml_tpu.train.local_sgd import (  # noqa: F401
     init_state,
     init_stacked_state,
 )
+from consensusml_tpu.train.outer import (  # noqa: F401
+    SlowMoConfig,
+    slowmo_init,
+    slowmo_update,
+)

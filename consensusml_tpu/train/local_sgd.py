@@ -34,6 +34,7 @@ from consensusml_tpu.consensus import (
     draw_alive,
     tree_all_finite,
 )
+from consensusml_tpu.train.outer import SlowMoConfig, slowmo_init, slowmo_update
 
 __all__ = [
     "LocalSGDConfig",
@@ -54,15 +55,18 @@ class TrainState(NamedTuple):
     opt_state: Any
     gossip: ChocoState | None
     rng: jax.Array
+    outer: Any = None  # SlowMo {x, u} when LocalSGDConfig.outer is set
 
 
 @dataclasses.dataclass(frozen=True)
 class LocalSGDConfig:
-    """One decentralized training round = H local steps + one gossip round."""
+    """One decentralized training round = H local steps + one gossip round
+    (+ an optional SlowMo slow-momentum step on the mixed params)."""
 
     gossip: GossipConfig
     optimizer: optax.GradientTransformation
     h: int = 1  # local (inner) steps between gossip rounds
+    outer: SlowMoConfig | None = None  # None => mixed params used as-is
 
     def engine(self) -> ConsensusEngine:
         return ConsensusEngine(self.gossip)
@@ -88,6 +92,7 @@ def init_state(cfg: LocalSGDConfig, params: Any, rng: jax.Array, model_state: An
         opt_state=cfg.optimizer.init(params),
         gossip=cfg.engine().init_state(_gossiped(params, model_state)),
         rng=rng,
+        outer=slowmo_init(params) if cfg.outer is not None else None,
     )
 
 
@@ -131,6 +136,7 @@ def init_stacked_state(
         opt_state=opt_state,
         gossip=cfg.engine().init_state(_gossiped(params, model_state)),
         rng=jax.vmap(jax.random.fold_in, in_axes=(0, None))(rngs, 1),
+        outer=slowmo_init(params) if cfg.outer is not None else None,
     )
 
 
@@ -268,6 +274,9 @@ def make_collective_train_step(
             step=state.step,
         )
         params, model_state = mixed["params"], mixed["model_state"]
+        outer = state.outer
+        if cfg.outer is not None:
+            params, outer = slowmo_update(cfg.outer, params, outer)
         err = engine.consensus_error_collective(params)
         new_state = TrainState(
             step=state.step + 1,
@@ -276,6 +285,7 @@ def make_collective_train_step(
             opt_state=opt_state,
             gossip=gossip,
             rng=rng,
+            outer=outer,
         )
         metrics = {
             "loss": mean_loss,
@@ -375,6 +385,10 @@ def make_simulated_train_step(
             _gossiped(params, model_state), state.gossip, w, alive, gsub
         )
         params, model_state = mixed["params"], mixed["model_state"]
+        outer = state.outer
+        if cfg.outer is not None:
+            # elementwise update — identical math on stacked worker arrays
+            params, outer = slowmo_update(cfg.outer, params, outer)
         err = engine.consensus_error_simulated(params)
         new_state = TrainState(
             step=state.step + 1,
@@ -383,6 +397,7 @@ def make_simulated_train_step(
             opt_state=opt_state,
             gossip=gossip,
             rng=rng,
+            outer=outer,
         )
         metrics = {"loss": mean_loss, "consensus_error": err}
         if faults is not None:

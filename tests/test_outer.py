@@ -1,0 +1,131 @@
+"""SlowMo outer optimizer: reduction to plain gossip, backend agreement,
+and convergence on top of local-SGD.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.comm import WorkerMesh
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.data import SyntheticClassification, round_batches
+from consensusml_tpu.models import MLP, mlp_loss_fn
+from consensusml_tpu.topology import RingTopology
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    SlowMoConfig,
+    init_stacked_state,
+    make_collective_train_step,
+    make_simulated_train_step,
+    slowmo_init,
+    slowmo_update,
+)
+
+
+def _setup(topo, outer, h=2, lr=1e-2):
+    model = MLP(hidden=16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo),
+        optimizer=optax.sgd(lr, momentum=0.9),
+        h=h,
+        outer=outer,
+    )
+    init = lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, cfg, init
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SlowMoConfig(beta=1.0)
+    with pytest.raises(ValueError):
+        SlowMoConfig(beta=-0.1)
+    with pytest.raises(ValueError):
+        SlowMoConfig(alpha=0.0)
+
+
+def test_beta0_alpha1_reduces_to_plain_gossip():
+    """SlowMo(beta=0, alpha=1) must reproduce the base round EXACTLY."""
+    topo = RingTopology(4)
+    data = SyntheticClassification(n=512)
+
+    def run(outer):
+        model, cfg, init = _setup(topo, outer)
+        step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+        state = init_stacked_state(cfg, init, jax.random.key(0), topo.world_size)
+        for batch in round_batches(data, topo.world_size, h=2, batch=16, rounds=4):
+            state, m = step(state, batch)
+        return state, m
+
+    base_state, base_m = run(None)
+    slow_state, slow_m = run(SlowMoConfig(beta=0.0, alpha=1.0))
+    assert float(base_m["loss"]) == pytest.approx(float(slow_m["loss"]), rel=1e-6)
+    for a, b in zip(
+        jax.tree.leaves(base_state.params), jax.tree.leaves(slow_state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_collective_matches_simulated_slowmo():
+    topo = RingTopology(4)
+    model, cfg, init = _setup(topo, SlowMoConfig(beta=0.8))
+    data = SyntheticClassification(n=512)
+    loss_fn = mlp_loss_fn(model)
+    sim_step = make_simulated_train_step(cfg, loss_fn)
+    wmesh = WorkerMesh.create(topo, platform="cpu")
+    col_step = make_collective_train_step(cfg, loss_fn, wmesh)
+    state = init_stacked_state(cfg, init, jax.random.key(4), topo.world_size)
+    sim_state, col_state = state, wmesh.shard_stacked(state)
+    for batch in round_batches(data, topo.world_size, h=2, batch=16, rounds=5):
+        sim_state, sm = sim_step(sim_state, batch)
+        col_state, cm = col_step(col_state, batch)
+    assert float(sm["loss"]) == pytest.approx(float(cm["loss"]), rel=1e-4)
+    for a, b in zip(
+        jax.tree.leaves(sim_state.params), jax.tree.leaves(col_state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_slowmo_converges_and_momentum_engages():
+    """SlowMo trains to low loss and its buffer is actually nonzero."""
+    topo = RingTopology(8)
+    model, cfg, init = _setup(topo, SlowMoConfig(beta=0.8), lr=5e-3)
+    data = SyntheticClassification(n=2048)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state = init_stacked_state(cfg, init, jax.random.key(1), topo.world_size)
+    losses = []
+    for batch in round_batches(data, topo.world_size, h=2, batch=32, rounds=40):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < 0.3 * losses[0]
+    u_norm = sum(
+        float(jnp.sum(jnp.abs(u))) for u in jax.tree.leaves(state.outer["u"])
+    )
+    assert u_norm > 0.0
+
+
+def test_slowmo_update_math():
+    """Pin the update equations on a scalar: d = x - y, u = beta*u + d,
+    x' = x - alpha*u."""
+    cfg = SlowMoConfig(beta=0.5, alpha=2.0)
+    params = {"w": jnp.asarray(10.0)}
+    state = slowmo_init(params)
+    # base round moved params 10 -> 8: pseudo-gradient d = 2
+    mixed = {"w": jnp.asarray(8.0)}
+    new, state = slowmo_update(cfg, mixed, state)
+    assert float(new["w"]) == pytest.approx(10.0 - 2.0 * 2.0)  # u = 2
+    assert float(state["u"]["w"]) == pytest.approx(2.0)
+    # next round from x=6, moved to 5: d = 1, u = 0.5*2 + 1 = 2, x' = 6 - 4
+    new, state = slowmo_update(cfg, {"w": jnp.asarray(5.0)}, state)
+    assert float(new["w"]) == pytest.approx(2.0)
+    assert float(state["u"]["w"]) == pytest.approx(2.0)
+
+
+def test_slowmo_preserves_bf16_param_dtype():
+    cfg = SlowMoConfig()
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    state = slowmo_init(params)
+    assert state["x"]["w"].dtype == jnp.float32  # f32 master copy
+    new, _ = slowmo_update(cfg, params, state)
+    assert new["w"].dtype == jnp.bfloat16

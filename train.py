@@ -36,6 +36,9 @@ def parse_args(argv=None):
     p.add_argument("--drop-prob", type=float, default=0.0,
                    help="per-round worker dropout probability (fault injection; "
                         "non-finite failure detection is enabled alongside it)")
+    p.add_argument("--slowmo-beta", type=float, default=None,
+                   help="enable the SlowMo outer optimizer with this slow-momentum "
+                        "decay (e.g. 0.8); default off")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -98,6 +101,14 @@ def main(argv=None) -> int:
             gossip=dataclasses.replace(
                 bundle.cfg.gossip, faults=FaultConfig(drop_prob=args.drop_prob)
             ),
+        )
+    if args.slowmo_beta is not None:
+        import dataclasses
+
+        from consensusml_tpu.train import SlowMoConfig
+
+        bundle.cfg = dataclasses.replace(
+            bundle.cfg, outer=SlowMoConfig(beta=args.slowmo_beta)
         )
 
     backend = args.backend
