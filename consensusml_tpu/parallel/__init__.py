@@ -11,6 +11,9 @@ the same device mesh.
 from consensusml_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
+from consensusml_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+)
 from consensusml_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,
     gpt2_tp_rules,

@@ -1,0 +1,77 @@
+"""Ulysses all-to-all attention must equal single-device attention on the
+gathered sequence, and agree with ring attention (the two SP strategies
+are interchangeable exact algorithms)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.parallel import ring_attention, ulysses_attention
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices("cpu")[:n]), ("sp",))
+
+
+def _run(fn, q, k, v, n, causal):
+    mesh = _mesh(n)
+    shard = NamedSharding(mesh, P(None, "sp"))
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"))
+    def f(q, k, v):
+        return fn(q, k, v, "sp", causal=causal)
+
+    return np.asarray(f(*(jax.device_put(x, shard) for x in (q, k, v))))
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+@pytest.mark.parametrize("n", [2, 4])
+def test_ulysses_matches_dense(causal, n):
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 32, 4, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+    want = np.asarray(
+        dot_product_attention(q, k, v, causal=causal, dtype=jnp.float32)
+    )
+    got = _run(ulysses_attention, q, k, v, n, causal)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    rng = np.random.default_rng(1)
+    b, s, h, d = 1, 64, 8, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+    got_u = _run(ulysses_attention, q, k, v, 8, True)
+    got_r = _run(ring_attention, q, k, v, 8, True)
+    np.testing.assert_allclose(got_u, got_r, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_bf16():
+    rng = np.random.default_rng(2)
+    b, s, h, d = 1, 16, 4, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.bfloat16) for _ in range(3)
+    )
+    want = np.asarray(
+        dot_product_attention(q, k, v, causal=True, dtype=jnp.bfloat16), np.float32
+    )
+    got = _run(ulysses_attention, q, k, v, 4, True).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    rng = np.random.default_rng(3)
+    b, s, h, d = 1, 16, 2, 8  # 2 heads over 4 devices: invalid
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        _run(ulysses_attention, q, k, v, 4, False)
