@@ -21,3 +21,10 @@ from consensusml_tpu.consensus.faults import (  # noqa: F401
     masked_mixing_matrix,
     tree_all_finite,
 )
+from consensusml_tpu.consensus.pushsum import (  # noqa: F401
+    PushSumState,
+    pushsum_init,
+    pushsum_matrix,
+    pushsum_round_collective,
+    pushsum_round_simulated,
+)

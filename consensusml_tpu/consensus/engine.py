@@ -30,6 +30,12 @@ import jax.numpy as jnp
 from consensusml_tpu.comm import collectives, simulated
 from consensusml_tpu.compress.base import Compressor
 from consensusml_tpu.consensus.faults import FaultConfig, masked_mixing_matrix
+from consensusml_tpu.consensus.pushsum import (
+    PushSumState,
+    pushsum_init,
+    pushsum_round_collective,
+    pushsum_round_simulated,
+)
 from consensusml_tpu.topology import Topology
 
 __all__ = ["GossipConfig", "ChocoState", "ConsensusEngine"]
@@ -56,6 +62,7 @@ class GossipConfig:
     gamma: float = 1.0  # CHOCO consensus step size (ignored when exact)
     path_filter: Any = None  # Callable[[tuple], bool] | None
     faults: FaultConfig | None = None  # None => no fault model
+    push_sum: bool = False  # ratio consensus (see consensus.pushsum)
 
     def __post_init__(self):
         if self.compressor is not None and self.faults is not None:
@@ -65,15 +72,21 @@ class GossipConfig:
                 "innovation, which a dropped round violates; use exact "
                 "gossip with faults, or compression without faults"
             )
-        if self.faults is not None and not self.topology.symmetric:
+        if self.compressor is not None and self.push_sum:
+            raise NotImplementedError(
+                "compressed push-sum is not supported: CHOCO's innovation "
+                "tracking assumes the row-stochastic mixing update, not "
+                "the biased-mass/ratio update"
+            )
+        if self.faults is not None and not self.topology.symmetric and not self.push_sum:
             raise NotImplementedError(
                 "fault masking requires a SYMMETRIC topology: folding a "
                 "dead peer's weight onto self keeps W doubly stochastic "
                 "(mean-preserving) only when W = W^T; a directed graph "
                 f"({self.topology.name}) would bias the network mean each "
-                "faulty round. Use ring/torus/dense/exp with faults, or a "
-                "directed topology without faults (push-sum averaging "
-                "would lift this restriction)"
+                "faulty round. Use ring/torus/dense/exp with faults, a "
+                "directed topology without faults, or push_sum=True "
+                "(ratio consensus is mean-exact on any graph)"
             )
 
 
@@ -109,13 +122,20 @@ class ConsensusEngine:
         return sel, rebuild
 
     # ---- state ----------------------------------------------------------
-    def init_state(self, params: Any) -> ChocoState | None:
-        """Zero CHOCO state shaped like ``params`` (None for exact gossip).
+    def init_state(
+        self, params: Any, world_size: int | None = None
+    ) -> ChocoState | PushSumState | None:
+        """Gossip state: zero CHOCO state shaped like ``params``, unit
+        push-sum mass, or None for exact mixing.
 
         Works for both backends: pass per-worker params (collective) or
-        stacked params (simulated). With a ``path_filter`` the state only
-        covers the filtered (gossiped) leaves.
+        stacked params with ``world_size`` (simulated / host-side stacked
+        construction — push-sum mass needs the explicit worker count since
+        it is a scalar, not params-shaped). With a ``path_filter`` CHOCO
+        state only covers the filtered (gossiped) leaves.
         """
+        if self.config.push_sum:
+            return pushsum_init(world_size)
         if not self.compressed:
             return None
         if self.config.path_filter is not None:
@@ -165,6 +185,12 @@ class ConsensusEngine:
         alive: jax.Array | None,
         rng: jax.Array | None,
     ):
+        if self.config.push_sum:
+            if self.config.path_filter is not None:
+                sel, rebuild = self._select(params)
+                mixed, new_state = pushsum_round_collective(sel, state, topo, alive)
+                return rebuild(mixed), new_state
+            return pushsum_round_collective(params, state, topo, alive)
         if not self.compressed:
             flt = self.config.path_filter
             if alive is not None:
@@ -244,6 +270,12 @@ class ConsensusEngine:
         ``(world,)`` keys for stochastic codecs — the same per-worker draws
         the collective backend makes.
         """
+        if self.config.push_sum:
+            if self.config.path_filter is not None:
+                sel, rebuild = self._select(params)
+                mixed, new_state = pushsum_round_simulated(sel, state, w, alive)
+                return rebuild(mixed), new_state
+            return pushsum_round_simulated(params, state, w, alive)
         if not self.compressed:
             if alive is not None:
                 w = masked_mixing_matrix(w, alive)

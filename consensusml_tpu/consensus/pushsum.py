@@ -1,0 +1,196 @@
+"""Push-sum (ratio) consensus: exact averaging on directed/faulty graphs.
+
+Plain masked gossip (consensus.faults) folds a dead peer's weight onto the
+RECEIVER's self-weight, which preserves the network mean only when the
+mixing matrix is symmetric — hence the engine's restriction of faults to
+undirected topologies. Push-sum (Kempe et al. 2003; stochastic gradient
+push, Assran et al. 2019) lifts that: every worker carries a scalar mass
+``w`` (init 1) alongside its parameters, both are mixed with a
+COLUMN-stochastic operator (each sender splits its outgoing mass to sum
+to 1, redistributing shares destined for dead receivers back onto
+itself), and the de-biased estimate is the ratio ``z = x / w``. Column
+stochasticity conserves ``sum_i x_i`` and ``sum_i w_i`` under ANY fault
+pattern and ANY directed graph, so ``z`` converges to the true initial
+network mean — no symmetry needed.
+
+Masking semantics (send-side; compare faults.masked_mixing_matrix's
+receive-side fold):
+
+    C'[i,j] = C[i,j] * a_i * a_j              (i != j)
+    C'[j,j] = a_j * (1 - sum_{i!=j} C[i,j] a_i) + (1 - a_j)
+
+On a SYMMETRIC topology the masked ``C'`` is doubly stochastic, ``w``
+stays exactly 1 and push-sum coincides with the existing masked mixing
+(tested); the new capability is directed graphs — e.g. one-peer
+exponential phases — under faults.
+
+Reference parity: SURVEY.md §5 flags fault tolerance as plausible in the
+reference (mount empty); this module is the TPU build's stronger version
+of it, enabled by how cheap the extra scalar ppermute is on ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.topology import Shift, Topology
+
+__all__ = [
+    "PushSumState",
+    "pushsum_init",
+    "pushsum_round_collective",
+    "pushsum_round_simulated",
+    "pushsum_matrix",
+]
+
+
+class PushSumState(NamedTuple):
+    """Per-worker push-sum mass (scalar; ``(world,)`` when stacked)."""
+
+    w: jax.Array
+
+
+def pushsum_init(world_size: int | None = None) -> PushSumState:
+    """Unit mass: scalar for the per-worker (collective) view, ``(world,)``
+    for stacked state."""
+    shape = () if world_size is None else (world_size,)
+    return PushSumState(w=jnp.ones(shape, jnp.float32))
+
+
+def _reverse(shift: Shift) -> Shift:
+    return Shift(shift.axis, -shift.offset, shift.weight)
+
+
+def _mass_mix(x: jax.Array, topology: Topology, alive, a_src, keep):
+    """One column-stochastic mass-mixing step on a single (f32) array.
+
+    With no faults the column-stochastic operator IS the topology's
+    doubly-stochastic mix, so defer to :func:`collectives.mix` (f32 in,
+    f32 out). The masked path differs from ``collectives.mix_masked``:
+    redistribution happens at the SENDER (column-preserving), not the
+    receiver (row-preserving).
+    """
+    from consensusml_tpu.comm import collectives
+
+    xf = jnp.asarray(x, jnp.float32)
+    if alive is None:
+        return collectives.mix(xf, topology)
+    acc = keep * xf
+    for s, a_s in zip(topology.shifts, a_src):
+        x_n = jnp.asarray(collectives.ppermute_shift(x, topology, s), jnp.float32)
+        acc = acc + s.weight * a_s * x_n
+    return jnp.where(alive > 0, acc, xf)
+
+
+def pushsum_round_collective(
+    tree: Any,
+    state: PushSumState,
+    topology: Topology,
+    alive: jax.Array | None = None,
+) -> tuple[Any, PushSumState]:
+    """One push-sum round, per-worker view (call inside ``shard_map``).
+
+    ``tree`` holds this worker's de-biased parameters ``z``; re-biases to
+    ``x = z * w``, mass-mixes ``(x, w)`` with the send-side-masked
+    column-stochastic operator, and returns ``(z_new, state_new)``.
+    ``alive`` is this worker's scalar 0/1 flag (None => nobody faults).
+    """
+    from consensusml_tpu.comm import collectives
+
+    w = state.w
+    if topology.uses_psum:
+        # dense: W is symmetric, so send-side masking coincides with
+        # mix_masked's receive-side fold — reuse it (f32 in, f32 out)
+        mass = (
+            (lambda x: collectives.mix(x, topology))
+            if alive is None
+            else (lambda x: collectives.mix_masked(x, topology, alive))
+        )
+        mixed = jax.tree.map(
+            lambda z: mass(jnp.asarray(z, jnp.float32) * w), tree
+        )
+        w_new = mass(w)
+        z_new = jax.tree.map(
+            lambda m, z: (m / w_new).astype(jnp.asarray(z).dtype), mixed, tree
+        )
+        return z_new, PushSumState(w=w_new)
+
+    if alive is None:
+        a_src = keep = None
+    else:
+        # exchange flags ONCE: senders' flags (in-neighbors) and my
+        # receivers' flags (out-neighbors, reverse shifts)
+        a_src = [collectives.ppermute_shift(alive, topology, s) for s in topology.shifts]
+        a_dst = [
+            collectives.ppermute_shift(alive, topology, _reverse(s))
+            for s in topology.shifts
+        ]
+        # redistribute shares destined for dead receivers onto self
+        keep = topology.self_weight + sum(
+            s.weight * (1.0 - a_d) for s, a_d in zip(topology.shifts, a_dst)
+        )
+
+    mixed = jax.tree.map(
+        lambda z: _mass_mix(
+            jnp.asarray(z, jnp.float32) * w, topology, alive, a_src, keep
+        ),
+        tree,
+    )
+    w_new = _mass_mix(w, topology, alive, a_src, keep)
+    z_new = jax.tree.map(
+        lambda m, z: (m / w_new).astype(jnp.asarray(z).dtype), mixed, tree
+    )
+    return z_new, PushSumState(w=w_new)
+
+
+def pushsum_matrix(w_mat: jax.Array, alive: jax.Array | None) -> jax.Array:
+    """Send-side-masked column-stochastic operator for the stacked backend.
+
+    ``w_mat``: the topology's (n, n) mixing matrix (doubly stochastic);
+    ``alive``: (n,) of 0/1 floats or None. Returns ``C'`` as defined in
+    the module docstring.
+    """
+    if alive is None:
+        return w_mat
+    n = w_mat.shape[0]
+    off = w_mat * alive[:, None] * alive[None, :]
+    off = off - jnp.diag(jnp.diag(off))
+    diag = alive * (1.0 - jnp.sum(off, axis=0)) + (1.0 - alive)
+    return off + jnp.diag(diag)
+
+
+def pushsum_round_simulated(
+    tree: Any,
+    state: PushSumState,
+    w_mat: jax.Array,
+    alive: jax.Array | None = None,
+) -> tuple[Any, PushSumState]:
+    """One push-sum round on stacked arrays (leading axis = workers)."""
+    c = pushsum_matrix(jnp.asarray(w_mat, jnp.float32), alive)
+    n = c.shape[0]
+    # a scalar mass (engine.init_state without world_size) means "all
+    # workers at unit mass" — broadcast rather than fail deep in reshape
+    w = jnp.broadcast_to(jnp.asarray(state.w, jnp.float32), (n,))
+
+    def mass_mix(x):
+        flat = jnp.asarray(x, jnp.float32).reshape(n, -1)
+        return (c @ flat).reshape(x.shape)
+
+    mixed = jax.tree.map(
+        lambda z: mass_mix(
+            jnp.asarray(z, jnp.float32) * w.reshape((n,) + (1,) * (z.ndim - 1))
+        ),
+        tree,
+    )
+    w_new = c @ w
+    z_new = jax.tree.map(
+        lambda m, z: (
+            m / w_new.reshape((n,) + (1,) * (m.ndim - 1))
+        ).astype(jnp.asarray(z).dtype),
+        mixed,
+        tree,
+    )
+    return z_new, PushSumState(w=w_new)

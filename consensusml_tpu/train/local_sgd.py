@@ -53,7 +53,7 @@ class TrainState(NamedTuple):
     params: Any
     model_state: Any  # non-gradient mutables (BN stats, ...); {} if none
     opt_state: Any
-    gossip: ChocoState | None
+    gossip: Any  # ChocoState | PushSumState | None per GossipConfig
     rng: jax.Array
     outer: Any = None  # SlowMo {x, u} when LocalSGDConfig.outer is set
 
@@ -134,7 +134,9 @@ def init_stacked_state(
         params=params,
         model_state=model_state,
         opt_state=opt_state,
-        gossip=cfg.engine().init_state(_gossiped(params, model_state)),
+        gossip=cfg.engine().init_state(
+            _gossiped(params, model_state), world_size=world_size
+        ),
         rng=jax.vmap(jax.random.fold_in, in_axes=(0, None))(rngs, 1),
         outer=slowmo_init(params) if cfg.outer is not None else None,
     )

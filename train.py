@@ -39,6 +39,9 @@ def parse_args(argv=None):
     p.add_argument("--slowmo-beta", type=float, default=None,
                    help="enable the SlowMo outer optimizer with this slow-momentum "
                         "decay (e.g. 0.8); default off")
+    p.add_argument("--push-sum", action="store_true",
+                   help="ratio-consensus averaging (exact mean on directed "
+                        "topologies and under faults; see consensus.pushsum)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -91,17 +94,28 @@ def main(argv=None) -> int:
     scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
     bundle = configs.build(args.config, scale)
 
-    if args.drop_prob > 0:
+    if args.drop_prob > 0 or args.push_sum:
         import dataclasses
 
         from consensusml_tpu.consensus import FaultConfig
 
-        bundle.cfg = dataclasses.replace(
-            bundle.cfg,
-            gossip=dataclasses.replace(
-                bundle.cfg.gossip, faults=FaultConfig(drop_prob=args.drop_prob)
-            ),
-        )
+        gossip = bundle.cfg.gossip
+        if args.push_sum and gossip.compressor is not None:
+            print(
+                "error: --push-sum is incompatible with a compressed-gossip "
+                "config (CHOCO tracking assumes row-stochastic mixing)",
+                file=sys.stderr,
+            )
+            return 2
+        # push_sum first: it is what makes faults legal on directed graphs,
+        # and GossipConfig validates on every replace
+        if args.push_sum:
+            gossip = dataclasses.replace(gossip, push_sum=True)
+        if args.drop_prob > 0:
+            gossip = dataclasses.replace(
+                gossip, faults=FaultConfig(drop_prob=args.drop_prob)
+            )
+        bundle.cfg = dataclasses.replace(bundle.cfg, gossip=gossip)
     if args.slowmo_beta is not None:
         import dataclasses
 
