@@ -5,3 +5,10 @@ from consensusml_tpu.utils.checkpoint import (  # noqa: F401
     save_state,
 )
 from consensusml_tpu.utils.logging import MetricsLogger  # noqa: F401
+from consensusml_tpu.utils.profiling import (  # noqa: F401
+    RoundStats,
+    RoundTimer,
+    annotate,
+    fence,
+    trace,
+)

@@ -1,0 +1,142 @@
+"""Tracing/profiling subsystem (SURVEY.md §5 aux subsystems).
+
+Three layers, smallest first:
+
+- :func:`annotate` — name a region of traced computation so it shows up
+  as a labeled span in XLA/xprof traces (``jax.named_scope``: attaches to
+  the HLO, so the label survives compilation — the TPU answer to the
+  reference's NVTX-style ranges).
+- :class:`RoundTimer` — honest wall-clock stats over training rounds.
+  "Honest" matters on this box: the tunneled TPU backend returns from
+  ``block_until_ready`` at enqueue time, so the timer fences each lap by
+  fetching a scalar to the host (see bench.py for the same trick).
+- :func:`trace` — a context manager around ``jax.profiler`` start/stop
+  that dumps an xprof/TensorBoard trace directory for deep dives
+  (per-op device timelines, HBM traffic, ICI collectives).
+
+Wired into ``train.py`` via ``--profile-dir`` (trace of a few steady-state
+rounds) and the end-of-run round-time summary.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import time
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+__all__ = ["annotate", "RoundTimer", "RoundStats", "trace", "fence"]
+
+
+def annotate(name: str):
+    """Label traced computation: ``with annotate("gossip"): ...`` inside a
+    jitted function tags the resulting HLO ops for xprof."""
+    return jax.named_scope(name)
+
+
+def fence(tree: Any) -> None:
+    """True execution barrier: fetch one scalar element per leaf to host.
+
+    ``jax.block_until_ready`` is NOT sufficient on tunneled backends
+    (observed on this box's axon TPU: it returns at enqueue). A device->
+    host copy cannot complete before the producing computation has, so
+    fetching is the reliable fence on every backend.
+    """
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            np.asarray(jax.device_get(leaf.addressable_shards[0].data)).ravel()[:1]
+        else:
+            np.asarray(leaf).ravel()[:1]
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundStats:
+    """Summary of per-round wall times (seconds)."""
+
+    count: int
+    mean_s: float
+    p50_s: float
+    p95_s: float
+    min_s: float
+    max_s: float
+    total_s: float
+
+    def format(self) -> str:
+        return (
+            f"rounds={self.count} mean={self.mean_s * 1e3:.1f}ms "
+            f"p50={self.p50_s * 1e3:.1f}ms p95={self.p95_s * 1e3:.1f}ms "
+            f"min={self.min_s * 1e3:.1f}ms max={self.max_s * 1e3:.1f}ms"
+        )
+
+
+class RoundTimer:
+    """Collects per-round wall times with an honest device fence per lap.
+
+    Usage::
+
+        timer = RoundTimer(warmup=1)
+        for batch in batches:
+            with timer.lap(metrics_fn=lambda: metrics):
+                state, metrics = step(state, batch)
+        print(timer.stats().format())
+
+    ``lap`` fences on whatever the ``metrics_fn`` thunk returns AFTER the
+    body ran (the body rebinds ``metrics``), so the measured lap includes
+    the full device execution of the step, not just its dispatch. The
+    first ``warmup`` laps (compilation) are recorded separately.
+    """
+
+    def __init__(self, warmup: int = 1):
+        self._warmup = warmup
+        self._laps: list[float] = []
+        self._warmup_laps: list[float] = []
+
+    @contextlib.contextmanager
+    def lap(self, metrics_fn=None) -> Iterator[None]:
+        t0 = time.time()
+        yield
+        if metrics_fn is not None:
+            fence(metrics_fn())
+        dt = time.time() - t0
+        if len(self._warmup_laps) < self._warmup:
+            self._warmup_laps.append(dt)
+        else:
+            self._laps.append(dt)
+
+    @property
+    def laps(self) -> list[float]:
+        return list(self._laps)
+
+    def stats(self) -> RoundStats:
+        laps = self._laps or self._warmup_laps
+        if not laps:
+            return RoundStats(0, math.nan, math.nan, math.nan, math.nan, math.nan, 0.0)
+        a = np.asarray(laps)
+        return RoundStats(
+            count=len(laps),
+            mean_s=float(a.mean()),
+            p50_s=float(np.percentile(a, 50)),
+            p95_s=float(np.percentile(a, 95)),
+            min_s=float(a.min()),
+            max_s=float(a.max()),
+            total_s=float(a.sum()),
+        )
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Dump an xprof trace of the enclosed block to ``log_dir``.
+
+    View with TensorBoard's profile plugin or xprof. Wraps
+    ``jax.profiler.start_trace``/``stop_trace`` so a mid-block exception
+    still stops the trace (leaving a valid dump).
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,72 @@
+"""Tracing/profiling subsystem: timer stats, fences, xprof trace dump,
+and the --profile-dir CLI path."""
+
+import glob
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusml_tpu.utils import RoundTimer, annotate, fence, trace
+
+
+def test_round_timer_separates_warmup_and_steady_state():
+    timer = RoundTimer(warmup=1)
+    for i in range(4):
+        with timer.lap():
+            time.sleep(0.05 if i == 0 else 0.01)
+    stats = timer.stats()
+    assert stats.count == 3  # warmup lap excluded
+    assert 0.005 < stats.p50_s < 0.05
+    assert stats.max_s < 0.05  # the slow compile lap is not in steady state
+    assert "p95" in stats.format()
+
+
+def test_round_timer_fences_on_metrics():
+    @jax.jit
+    def slow(x):
+        return jnp.sum(x * x)
+
+    timer = RoundTimer(warmup=0)
+    metrics = {}
+    x = jnp.ones((256, 256))
+    with timer.lap(metrics_fn=lambda: metrics):
+        metrics = {"loss": slow(x)}
+    assert timer.stats().count == 1
+    assert np.isfinite(timer.stats().mean_s)
+
+
+def test_fence_handles_trees_and_empty():
+    fence({})
+    fence({"a": jnp.ones((3,)), "b": [jnp.zeros(())]})
+
+
+def test_annotate_composes_with_jit():
+    @jax.jit
+    def f(x):
+        with annotate("gossip"):
+            return x * 2
+
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(4))), 2.0)
+
+
+def test_trace_writes_xprof_dump(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace(d):
+        jnp.sum(jnp.ones((64, 64))).block_until_ready()
+    dumped = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert dumped, "trace produced no files"
+
+
+def test_cli_profile_dir(tmp_path):
+    from train import main
+
+    d = str(tmp_path / "prof")
+    rc = main([
+        "--config", "mnist_mlp", "--device", "cpu", "--backend", "simulated",
+        "--rounds", "6", "--profile-dir", d, "--log-every", "100",
+    ])
+    assert rc == 0
+    assert glob.glob(os.path.join(d, "**", "*"), recursive=True)
