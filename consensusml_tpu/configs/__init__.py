@@ -34,7 +34,12 @@ from consensusml_tpu.data import (
     round_batches,
 )
 from consensusml_tpu.topology import topology_from_name
-from consensusml_tpu.train import LocalSGDConfig
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    causal_lm_eval_fn,
+    classification_eval_fn,
+    mlm_eval_fn,
+)
 
 __all__ = ["RunBundle", "CONFIGS", "build", "names"]
 
@@ -50,6 +55,51 @@ class RunBundle:
     init_params: Callable[[jax.Array], Any]
     batches: Callable[..., Iterator[dict]]  # (rounds, seed, start=0) -> iterator
     description: str
+    # held-out evaluation (train.evaluate): sums-returning metric fn +
+    # UNSTACKED held-out batch iterator (n_batches, seed) -> iterator
+    eval_fn: Callable | None = None
+    eval_batches: Callable[[int, int], Iterator[dict]] | None = None
+
+
+# keeps held-out LM sample streams disjoint from every training round key
+_EVAL_SEED_OFFSET = 999_983
+
+
+def _lm_eval_batches(data, batch: int, *, mlm_rate: float = 0.0):
+    """Held-out LM batches: same Markov chain, disjoint rng keys."""
+    from consensusml_tpu.data.synthetic import mlm_corrupt
+    import numpy as np
+
+    def gen(n_batches: int, seed: int):
+        for r in range(n_batches):
+            rng = np.random.default_rng((seed + _EVAL_SEED_OFFSET, r))
+            ids = data.sample(rng, (batch,))
+            if mlm_rate > 0:
+                yield mlm_corrupt(ids, data, seed + _EVAL_SEED_OFFSET, r, mlm_rate)
+            else:
+                yield {"input_ids": jnp.asarray(ids)}
+
+    return gen
+
+
+def _cls_eval_batches(data, batch: int):
+    """Held-out classification batches from the dataset's holdout split.
+
+    The holdout split materializes lazily on first use, so eval-less runs
+    (and ``--list``) never pay for a second dataset copy."""
+    import numpy as np
+
+    def gen(n_batches: int, seed: int):
+        held = data.holdout()
+        for r in range(n_batches):
+            rng = np.random.default_rng((seed + _EVAL_SEED_OFFSET, r))
+            idx = rng.integers(0, held.n, size=batch)
+            yield {
+                "image": jnp.asarray(held.images[idx]),
+                "label": jnp.asarray(held.labels[idx]),
+            }
+
+    return gen
 
 
 def _mnist_mlp(scale: str) -> RunBundle:
@@ -73,6 +123,8 @@ def _mnist_mlp(scale: str) -> RunBundle:
         init_params=lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
         batches=lambda rounds, seed, start=0: round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
         description="2-layer MLP, 4 workers, dense gossip (CPU reference config)",
+        eval_fn=classification_eval_fn(model),
+        eval_batches=_cls_eval_batches(data, batch),
     )
 
 
@@ -107,6 +159,8 @@ def _cifar_resnet50(scale: str) -> RunBundle:
         init_params=resnet_init(model, (1, image, image, 3)),
         batches=lambda rounds, seed, start=0: round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
         description="ResNet-50 (CIFAR stem), 8-worker ring consensus",
+        eval_fn=classification_eval_fn(model, train_kwarg=True),
+        eval_batches=_cls_eval_batches(data, batch),
     )
 
 
@@ -141,6 +195,8 @@ def _bert_mlm(scale: str) -> RunBundle:
             data, world, cfg.h, batch, rounds, seed, mlm_rate=0.15, start=start
         ),
         description="BERT MLM, local-SGD H=8 + periodic ring averaging",
+        eval_fn=mlm_eval_fn(model),
+        eval_batches=_lm_eval_batches(data, batch, mlm_rate=0.15),
     )
 
 
@@ -187,6 +243,8 @@ def _llama_lora(scale: str) -> RunBundle:
         init_params=init,
         batches=lambda rounds, seed, start=0: lm_round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
         description=f"Llama LoRA fine-tune, {rows}x{cols} torus gossip (adapters-only wire)",
+        eval_fn=causal_lm_eval_fn(model, deterministic_kwarg=False),
+        eval_batches=_lm_eval_batches(data, batch),
     )
 
 
@@ -225,6 +283,8 @@ def _gpt2_topk(scale: str) -> RunBundle:
         init_params=lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],
         batches=lambda rounds, seed, start=0: lm_round_batches(data, world, cfg.h, batch, rounds, seed, start=start),
         description="GPT-2 pretrain with top-k + int8 compressed gossip (CHOCO)",
+        eval_fn=causal_lm_eval_fn(model),
+        eval_batches=_lm_eval_batches(data, batch),
     )
 
 

@@ -27,17 +27,29 @@ class SyntheticClassification:
     classes: int = 10
     noise: float = 0.35
     seed: int = 0
+    # None => samples come from the prototype rng stream (training split).
+    # An int selects an independent sample stream over the SAME prototypes
+    # — a held-out split of the same task (see holdout()).
+    sample_seed: int | None = None
 
     def __post_init__(self):
         rng = np.random.default_rng(self.seed)
         self.prototypes = rng.normal(size=(self.classes, *self.image_shape)).astype(
             np.float32
         )
+        if self.sample_seed is not None:
+            rng = np.random.default_rng((self.seed, self.sample_seed))
         self.labels = rng.integers(0, self.classes, size=self.n).astype(np.int32)
         self.images = (
             self.prototypes[self.labels]
             + self.noise * rng.normal(size=(self.n, *self.image_shape))
         ).astype(np.float32)
+
+    def holdout(self, n: int | None = None) -> "SyntheticClassification":
+        """Held-out split: same class prototypes, disjoint sample stream."""
+        return dataclasses.replace(
+            self, n=n or self.n, sample_seed=(self.sample_seed or 0) + 1
+        )
 
     def worker_shard(self, rank: int, world_size: int) -> tuple[np.ndarray, np.ndarray]:
         """Disjoint contiguous shard for one worker (reference-style DP

@@ -25,3 +25,10 @@ from consensusml_tpu.train.outer import (  # noqa: F401
     slowmo_init,
     slowmo_update,
 )
+from consensusml_tpu.train.evaluate import (  # noqa: F401
+    causal_lm_eval_fn,
+    classification_eval_fn,
+    evaluate,
+    make_stacked_eval_step,
+    mlm_eval_fn,
+)

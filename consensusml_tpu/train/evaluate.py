@@ -1,0 +1,187 @@
+"""Evaluation: per-worker and consensus-model metrics on held-out data.
+
+The reference's parity condition is "matching top-1 accuracy"
+(BASELINE.json north_star), so accuracy is a first-class metric here, not
+an afterthought. Decentralized training adds a twist a centralized eval
+loop doesn't have: there are W disagreeing replicas AND the consensus
+model (the worker-mean parameters — what you would actually deploy).
+This module reports both; the gap between them closes as consensus-error
+goes to zero.
+
+Metric functions return SUMS (not means) so results accumulate exactly
+across eval batches:
+
+- classification: ``{"correct": .., "count": ..}``
+- masked LM:      ``{"correct": .., "count": .., "nll": ..}`` over masked
+  positions
+- causal LM:      ``{"nll": .., "count": ..}`` next-token
+
+``evaluate`` derives ``top1`` (= correct/count) and ``ppl``
+(= exp(nll/count)) from whichever sums are present.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "classification_eval_fn",
+    "mlm_eval_fn",
+    "causal_lm_eval_fn",
+    "make_stacked_eval_step",
+    "evaluate",
+]
+
+EvalFn = Callable[[Any, Any, Any], dict[str, jax.Array]]
+
+
+# ---------------------------------------------------------------------------
+# per-family metric functions
+# ---------------------------------------------------------------------------
+
+
+def classification_eval_fn(model, *, train_kwarg: bool = False) -> EvalFn:
+    """Top-1 accuracy sums for image classifiers (MLP / ResNet).
+
+    ``train_kwarg=True`` passes ``train=False`` (BatchNorm models need it
+    to use running statistics from ``model_state``)."""
+
+    def eval_fn(params, model_state, batch):
+        variables = {"params": params, **model_state}
+        if train_kwarg:
+            logits = model.apply(variables, batch["image"], train=False)
+        else:
+            logits = model.apply(variables, batch["image"])
+        pred = jnp.argmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        return {
+            "correct": jnp.sum((pred == batch["label"]).astype(jnp.float32)),
+            "count": jnp.asarray(pred.size, jnp.float32),
+        }
+
+    return eval_fn
+
+
+def mlm_eval_fn(model) -> EvalFn:
+    """Masked-position accuracy + NLL sums for BERT-style MLM."""
+
+    def eval_fn(params, model_state, batch):
+        import optax
+
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=True,
+        )
+        logits = jnp.asarray(logits, jnp.float32)
+        labels = batch["labels"]
+        mask = jnp.asarray(batch["mlm_mask"], jnp.float32)
+        pred = jnp.argmax(logits, axis=-1)
+        nll = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        return {
+            "correct": jnp.sum((pred == labels).astype(jnp.float32) * mask),
+            "count": jnp.sum(mask),
+            "nll": jnp.sum(nll * mask),
+        }
+
+    return eval_fn
+
+
+def causal_lm_eval_fn(model, *, deterministic_kwarg: bool = True) -> EvalFn:
+    """Next-token NLL sums for causal LMs (GPT-2 / Llama)."""
+
+    def eval_fn(params, model_state, batch):
+        import optax
+
+        ids = batch["input_ids"]
+        if deterministic_kwarg:
+            logits = model.apply({"params": params}, ids, deterministic=True)
+        else:
+            logits = model.apply({"params": params}, ids)
+        logits = jnp.asarray(logits[:, :-1], jnp.float32)
+        nll = optax.softmax_cross_entropy_with_integer_labels(logits, ids[:, 1:])
+        return {
+            "nll": jnp.sum(nll),
+            "count": jnp.asarray(nll.size, jnp.float32),
+        }
+
+    return eval_fn
+
+
+# ---------------------------------------------------------------------------
+# stacked evaluation
+# ---------------------------------------------------------------------------
+
+
+def make_stacked_eval_step(eval_fn: EvalFn):
+    """Jitted eval over stacked state: every replica AND the worker-mean
+    (consensus) model score the SAME batch.
+
+    Inputs: stacked ``params``/``model_state`` with a flat leading worker
+    axis; an UNSTACKED batch (all workers see the same held-out data).
+    Returns ``(per_worker_sums, mean_model_sums)`` where per-worker leaves
+    carry the ``(W,)`` axis.
+    """
+
+    @jax.jit
+    def eval_step(params, model_state, batch):
+        per = jax.vmap(eval_fn, in_axes=(0, 0, None))(params, model_state, batch)
+        f32mean = lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(
+            x.dtype
+        )
+        mean_params = jax.tree.map(f32mean, params)
+        mean_state = jax.tree.map(f32mean, model_state)
+        mean = eval_fn(mean_params, mean_state, batch)
+        return per, mean
+
+    return eval_step
+
+
+def _derive(sums: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    out = {}
+    count = sums.get("count")
+    if count is None:
+        return dict(sums)
+    if "correct" in sums:
+        out["top1"] = sums["correct"] / np.maximum(count, 1.0)
+    if "nll" in sums:
+        out["nll"] = sums["nll"] / np.maximum(count, 1.0)
+        out["ppl"] = np.exp(out["nll"])
+    return out
+
+
+def evaluate(
+    eval_fn: EvalFn, state, batches: Iterable[Any]
+) -> dict[str, Any]:
+    """Accumulate eval sums over ``batches`` and derive metrics.
+
+    ``state`` is a stacked TrainState (either backend — the collective
+    backend's sharded arrays evaluate under the same jit). Returns::
+
+        {"mean_model": {"top1": ..}, "per_worker": {"top1": array (W,)},
+         "worker_mean": {"top1": ..}}   # scalar mean over workers
+    """
+    step = make_stacked_eval_step(eval_fn)
+    tot_per: dict[str, np.ndarray] | None = None
+    tot_mean: dict[str, np.ndarray] | None = None
+    for batch in batches:
+        per, mean = step(state.params, state.model_state, batch)
+        per = {k: np.asarray(jax.device_get(v), np.float64) for k, v in per.items()}
+        mean = {k: np.asarray(jax.device_get(v), np.float64) for k, v in mean.items()}
+        if tot_per is None:
+            tot_per, tot_mean = per, mean
+        else:
+            tot_per = {k: tot_per[k] + v for k, v in per.items()}
+            tot_mean = {k: tot_mean[k] + v for k, v in mean.items()}
+    if tot_per is None:
+        raise ValueError("evaluate() got an empty batch iterator")
+    per_metrics = _derive(tot_per)
+    return {
+        "mean_model": _derive(tot_mean),
+        "per_worker": per_metrics,
+        "worker_mean": {k: float(np.mean(v)) for k, v in per_metrics.items()},
+    }
