@@ -11,6 +11,10 @@ the same device mesh.
 from consensusml_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
+from consensusml_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_last_stage_mean,
+)
 from consensusml_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
 )

@@ -18,6 +18,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 
@@ -45,6 +46,11 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
+    p.add_argument("--profile-dir", default=None,
+                   help="dump an xprof trace of rounds 2-4 to this directory")
+    p.add_argument("--eval-batches", type=int, default=0,
+                   help="after training, score this many held-out batches "
+                        "(per-worker AND consensus-mean-model top-1/ppl)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0, help="rounds; 0 = end only")
     p.add_argument("--resume", default=None, help="checkpoint path to resume from")
@@ -159,12 +165,24 @@ def main(argv=None) -> int:
         start = int(np.asarray(jax.device_get(state.step)).ravel()[0])
         print(f"resumed from {args.resume} at round {start}", flush=True)
 
+    from consensusml_tpu.utils import RoundTimer, trace as profile_trace
+
     logger = MetricsLogger(args.metrics_out, every=args.log_every)
+    timer = RoundTimer(warmup=1)  # round 0 carries XLA compilation
     metrics = {}
     last_saved = None
+    profiling = contextlib.nullcontext()
     for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
         rnd = start + i
-        state, metrics = step(state, batch)
+        if args.profile_dir and i == 2:
+            profiling = profile_trace(args.profile_dir)
+            profiling.__enter__()
+        with timer.lap(metrics_fn=lambda: metrics):
+            state, metrics = step(state, batch)
+        if args.profile_dir and i == 4:
+            profiling.__exit__(None, None, None)
+            profiling = contextlib.nullcontext()
+            print(f"profile trace: {args.profile_dir}", flush=True)
         logger.log(rnd, metrics)
         if (
             args.checkpoint_dir
@@ -173,6 +191,10 @@ def main(argv=None) -> int:
         ):
             save_state(args.checkpoint_dir, jax.device_get(state), step=rnd + 1)
             last_saved = rnd + 1
+    if not isinstance(profiling, contextlib.nullcontext):
+        # run ended before round 4: close the trace so the dump is valid
+        profiling.__exit__(None, None, None)
+        print(f"profile trace: {args.profile_dir}", flush=True)
     if args.checkpoint_dir and last_saved != start + args.rounds:
         path = save_state(
             args.checkpoint_dir, jax.device_get(state), step=start + args.rounds
@@ -180,9 +202,25 @@ def main(argv=None) -> int:
         print(f"checkpoint: {path}", flush=True)
     logger.close()
     if metrics:
+        print(f"timing: {timer.stats().format()}", flush=True)
         print(
             f"final: loss={float(metrics['loss']):.4f} "
             f"consensus_error={float(metrics['consensus_error']):.4f}",
+            flush=True,
+        )
+    if args.eval_batches > 0:
+        if bundle.eval_fn is None or bundle.eval_batches is None:
+            print("error: this config has no held-out eval", file=sys.stderr)
+            return 2
+        from consensusml_tpu.train import evaluate
+
+        result = evaluate(
+            bundle.eval_fn, state, bundle.eval_batches(args.eval_batches, args.seed)
+        )
+        fmt = lambda d: " ".join(f"{k}={float(v):.4f}" for k, v in sorted(d.items()))
+        print(
+            f"eval[mean-model]: {fmt(result['mean_model'])}\n"
+            f"eval[worker-avg]: {fmt(result['worker_mean'])}",
             flush=True,
         )
     return 0
