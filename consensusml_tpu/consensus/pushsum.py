@@ -27,6 +27,18 @@ exponential phases — under faults.
 Reference parity: SURVEY.md §5 flags fault tolerance as plausible in the
 reference (mount empty); this module is the TPU build's stronger version
 of it, enabled by how cheap the extra scalar ppermute is on ICI.
+
+Known deviation from classic stochastic gradient push (Assran et al.
+2019): the trainer applies local SGD steps to the DE-BIASED variable
+``z`` directly, where SGP applies them to the biased numerator
+``x = z * w``. Re-biasing ``z * w`` at the next round therefore scales
+each worker's inner-loop update by its current mass ``w``, a systematic
+re-weighting whenever ``w`` deviates from 1 (i.e. under faults on
+directed graphs). The impact is bounded: column stochasticity conserves
+total mass, each ``w_i`` stays within the mixing operator's dynamic
+range of 1, and the tests' convergence runs cover the faulty-directed
+case — but exact SGP equivalence would require the trainer to re-bias
+params to ``x`` before the inner loop and de-bias after.
 """
 
 from __future__ import annotations

@@ -22,6 +22,7 @@ across eval batches:
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Iterable
 
 import jax
@@ -117,6 +118,9 @@ def causal_lm_eval_fn(model, *, deterministic_kwarg: bool = True) -> EvalFn:
 # ---------------------------------------------------------------------------
 
 
+_EVAL_STEP_CACHE: "weakref.WeakKeyDictionary[Any, Any]" = weakref.WeakKeyDictionary()
+
+
 def make_stacked_eval_step(eval_fn: EvalFn):
     """Jitted eval over stacked state: every replica AND the worker-mean
     (consensus) model score the SAME batch.
@@ -125,7 +129,19 @@ def make_stacked_eval_step(eval_fn: EvalFn):
     axis; an UNSTACKED batch (all workers see the same held-out data).
     Returns ``(per_worker_sums, mean_model_sums)`` where per-worker leaves
     carry the ``(W,)`` axis.
+
+    Memoized per ``eval_fn`` (weakly, so closures don't leak) — repeated
+    :func:`evaluate` calls during training reuse one compiled step instead
+    of re-jitting each time.
+
+    Note: the "mean model" is the UNWEIGHTED mean of the de-biased
+    replicas. For push-sum runs this is not exactly the mass-weighted
+    network mean; the gap is bounded by the consensus error and vanishes
+    as it does.
     """
+    cached = _EVAL_STEP_CACHE.get(eval_fn)
+    if cached is not None:
+        return cached
 
     @jax.jit
     def eval_step(params, model_state, batch):
@@ -138,6 +154,7 @@ def make_stacked_eval_step(eval_fn: EvalFn):
         mean = eval_fn(mean_params, mean_state, batch)
         return per, mean
 
+    _EVAL_STEP_CACHE[eval_fn] = eval_step
     return eval_step
 
 

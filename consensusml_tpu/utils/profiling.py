@@ -48,7 +48,11 @@ def fence(tree: Any) -> None:
     """
     for leaf in jax.tree.leaves(tree):
         if hasattr(leaf, "addressable_shards"):
-            np.asarray(jax.device_get(leaf.addressable_shards[0].data)).ravel()[:1]
+            shard = leaf.addressable_shards[0].data
+            # slice ON DEVICE first: device_get of the raw shard would copy
+            # the whole buffer to host, a hidden D2H if fencing on params
+            first = shard.reshape(-1)[:1] if shard.size else shard
+            np.asarray(jax.device_get(first))
         else:
             np.asarray(leaf).ravel()[:1]
 

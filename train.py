@@ -47,7 +47,7 @@ def parse_args(argv=None):
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument("--profile-dir", default=None,
-                   help="dump an xprof trace of rounds 2-4 to this directory")
+                   help="dump an xprof trace of rounds 2-3 to this directory")
     p.add_argument("--eval-batches", type=int, default=0,
                    help="after training, score this many held-out batches "
                         "(per-worker AND consensus-mean-model top-1/ppl)")
