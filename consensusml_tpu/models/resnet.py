@@ -9,7 +9,11 @@ TPU-first choices:
 - NHWC layout (XLA:TPU's native conv layout — channels on the 128-lane
   minor dimension feeds the MXU directly);
 - bf16 compute / f32 BatchNorm statistics and params (MXU-native mixed
-  precision);
+  precision). ``norm_dtype`` selects the BN *elementwise compute* dtype;
+  flax computes the mean/var reductions in float32 regardless
+  (``force_float32_reductions``), so ``norm_dtype=bfloat16`` (the
+  default, matching ``dtype``) keeps the normalize/scale/relu chain in
+  bf16 — halving its HBM traffic — without touching statistic precision;
 - BatchNorm running stats live in the ``batch_stats`` collection and are
   returned as ``model_state`` so the trainer gossip-averages them across
   workers along with the weights.
@@ -107,6 +111,7 @@ class ResNet(nn.Module):
     width: int = 64
     stem: str = "imagenet"  # or "cifar"
     dtype: Any = jnp.bfloat16
+    norm_dtype: Any = None  # BN elementwise dtype; None => same as dtype
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -116,7 +121,8 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,  # normalize/track stats in f32
+            # mean/var reductions stay float32 inside flax regardless
+            dtype=self.dtype if self.norm_dtype is None else self.norm_dtype,
         )
         x = jnp.asarray(x, self.dtype)
         if self.stem == "imagenet":
@@ -145,19 +151,25 @@ class ResNet(nn.Module):
         return jnp.asarray(x, jnp.float32)
 
 
-def resnet18(num_classes: int = 10, stem: str = "cifar", dtype=jnp.bfloat16) -> ResNet:
+def resnet18(
+    num_classes: int = 10, stem: str = "cifar", dtype=jnp.bfloat16, norm_dtype=None
+) -> ResNet:
     return ResNet(
-        stage_sizes=[2, 2, 2, 2], block=BasicBlock, num_classes=num_classes, stem=stem, dtype=dtype
+        stage_sizes=[2, 2, 2, 2], block=BasicBlock, num_classes=num_classes,
+        stem=stem, dtype=dtype, norm_dtype=norm_dtype,
     )
 
 
-def resnet50(num_classes: int = 1000, stem: str = "imagenet", dtype=jnp.bfloat16) -> ResNet:
+def resnet50(
+    num_classes: int = 1000, stem: str = "imagenet", dtype=jnp.bfloat16, norm_dtype=None
+) -> ResNet:
     return ResNet(
         stage_sizes=[3, 4, 6, 3],
         block=BottleneckBlock,
         num_classes=num_classes,
         stem=stem,
         dtype=dtype,
+        norm_dtype=norm_dtype,
     )
 
 

@@ -1,0 +1,174 @@
+"""Perf sweep for the headline ResNet-50 bench (one variant per subprocess).
+
+Drives the same measurement as bench.py (scan-of-steps inside one jit,
+host value fetch as the timing fence — see bench.py for why that is the
+honest protocol on this box's enqueue-returning tunneled TPU backend)
+across configuration variants, to locate the throughput sinks
+profile-style without hand-reading traces first:
+
+  path  : sim  — the bench's make_simulated_train_step (vmap over 1 worker)
+          raw  — plain jitted fwd+bwd+SGD step, no vmap/gossip wrapper
+  batch : images per step
+  bn    : f32 | bf16 BatchNorm elementwise dtype (ResNet.norm_dtype)
+
+Usage:  python tools/perf_sweep.py sim:128:f32 raw:256:bf16 ...
+Each spec runs in a fresh subprocess (clean XLA client, honest compile).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)  # script lives in tools/, package at repo root
+
+
+def run_variant(path: str, batch: int, bn: str, steps: int, image: int) -> dict:
+    import functools
+
+    import jax
+
+    if os.environ.get("BENCH_DEVICE"):
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from consensusml_tpu.models import resnet50, resnet_loss_fn
+
+    model = resnet50(
+        num_classes=1000,
+        stem="imagenet",
+        dtype=jnp.bfloat16,
+        norm_dtype=jnp.float32 if bn == "f32" else None,
+    )
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+    )
+    labels = jnp.asarray(rng.integers(0, 1000, size=(batch,)), jnp.int32)
+    loss_fn = resnet_loss_fn(model)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    if path == "raw":
+        variables = model.init(jax.random.key(0), images[:1], train=True)
+        params = variables["params"]
+        mstate = {k: v for k, v in variables.items() if k != "params"}
+        opt_state = tx.init(params)
+        carry0 = (params, mstate, opt_state, jax.random.key(1))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def multi_step(carry):
+            def body(c, _):
+                params, mstate, opt_state, key = c
+                key, sub = jax.random.split(key)
+                (loss, mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, mstate, {"image": images, "label": labels}, sub)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, mstate, opt_state, key), loss
+
+            return jax.lax.scan(body, carry, None, length=steps)
+
+        t0 = time.time()
+        carry, losses = multi_step(carry0)
+        warm = float(losses[-1])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        carry, losses = multi_step(carry)
+        final = float(losses[-1])
+        dt = time.time() - t0
+    else:  # sim — the exact bench path
+        from consensusml_tpu.consensus import GossipConfig
+        from consensusml_tpu.models import resnet_init
+        from consensusml_tpu.topology import RingTopology
+        from consensusml_tpu.train import (
+            LocalSGDConfig,
+            init_stacked_state,
+            make_simulated_train_step,
+        )
+
+        cfg = LocalSGDConfig(
+            gossip=GossipConfig(topology=RingTopology(1)), optimizer=tx, h=1
+        )
+        step = make_simulated_train_step(cfg, loss_fn)
+        state = init_stacked_state(
+            cfg, resnet_init(model, (1, image, image, 3)), jax.random.key(0), 1
+        )
+        batch_data = {
+            "image": images[None, None],
+            "label": labels[None, None],
+        }
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def multi_step(state):
+            def body(s, _):
+                s, m = step(s, batch_data)
+                return s, m["loss"]
+
+            return jax.lax.scan(body, state, None, length=steps)
+
+        t0 = time.time()
+        state, losses = multi_step(state)
+        warm = float(losses[-1])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        state, losses = multi_step(state)
+        final = float(losses[-1])
+        dt = time.time() - t0
+
+    return {
+        "variant": f"{path}:{batch}:{bn}",
+        "imgs_sec": round(batch * steps / dt, 1),
+        "step_ms": round(1000 * dt / steps, 2),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.default_backend(),
+        "loss": round(final, 4),
+        "warm_loss": round(warm, 4),
+    }
+
+
+def main() -> None:
+    if "--_one" in sys.argv:
+        spec = sys.argv[sys.argv.index("--_one") + 1]
+        path, batch, bn = spec.split(":")
+        steps = int(os.environ.get("SWEEP_STEPS", "20"))
+        image = int(os.environ.get("SWEEP_IMAGE", "224"))
+        print(
+            "VARIANT_RESULT "
+            + json.dumps(run_variant(path, int(batch), bn, steps, image)),
+            flush=True,
+        )
+        return
+
+    specs = [a for a in sys.argv[1:] if ":" in a]
+    for spec in specs:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_one", spec],
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("SWEEP_TIMEOUT", "1200")),
+            cwd=REPO,
+        )
+        out = [
+            l for l in proc.stdout.splitlines() if l.startswith("VARIANT_RESULT ")
+        ]
+        if out:
+            print(out[-1][len("VARIANT_RESULT "):], flush=True)
+        else:
+            print(
+                json.dumps(
+                    {"variant": spec, "error": proc.stderr[-400:]}
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
