@@ -25,6 +25,10 @@ from consensusml_tpu.compress.base import (  # noqa: F401
     Int8Payload,
     TopKPayload,
 )
+from consensusml_tpu.compress.kernels import (  # noqa: F401
+    ChunkedTopKCompressor,
+    PallasInt8Compressor,
+)
 from consensusml_tpu.compress.extra import (  # noqa: F401
     LowRankPayload,
     PowerSGDCompressor,

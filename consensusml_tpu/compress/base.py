@@ -132,6 +132,32 @@ class Compressor(abc.ABC):
         decompressed = [self.decompress(p) for p in flat_payloads]
         return jax.tree.unflatten(jax.tree.structure(like), decompressed)
 
+    def decompress_accumulate(
+        self, payload, acc: jax.Array, weight
+    ) -> jax.Array:
+        """Fused receive: ``acc + weight * decompress(payload)``.
+
+        The consensus engine's compressed receive path accumulates each
+        neighbor's payload into a running sum (SURVEY.md §2 native
+        component 3: fused decompress-and-accumulate). The default decodes
+        densely and lets XLA fuse the axpy; SPARSE codecs override with a
+        direct scatter-add so no dense per-neighbor temporary is ever
+        materialized (degree x full-tensor f32 saved per round).
+        """
+        return acc + weight * jnp.asarray(self.decompress(payload), acc.dtype)
+
+    def decompress_accumulate_tree(
+        self, payload_tree: Any, acc_tree: Any, weight
+    ) -> Any:
+        """Leaf-wise :meth:`decompress_accumulate` over a payload tree."""
+        flat_payloads = _payload_leaves(payload_tree, acc_tree)
+        acc_leaves, treedef = jax.tree.flatten(acc_tree)
+        out = [
+            self.decompress_accumulate(p, a, weight)
+            for p, a in zip(flat_payloads, acc_leaves)
+        ]
+        return jax.tree.unflatten(treedef, out)
+
 
 def _payload_leaves(payload_tree: Any, like: Any) -> list:
     """Split a mapped payload tree back into one payload per ``like`` leaf."""
@@ -186,8 +212,19 @@ class ComposedCompressor(Compressor):
         )
 
     def decompress(self, payload) -> jax.Array:
-        values = self.outer.decompress(payload.values)
-        inner_payload = TopKPayload(
-            values=values, indices=payload.indices, shape=payload.shape, dtype=payload.dtype
+        return self.inner.decompress(self._inner_payload(payload))
+
+    def decompress_accumulate(self, payload, acc: jax.Array, weight) -> jax.Array:
+        # decode the (small, k-sized) values densely, then delegate to the
+        # inner sparse codec's scatter-add — still no dense full-tensor temp
+        return self.inner.decompress_accumulate(
+            self._inner_payload(payload), acc, weight
         )
-        return self.inner.decompress(inner_payload)
+
+    def _inner_payload(self, payload) -> TopKPayload:
+        return TopKPayload(
+            values=self.outer.decompress(payload.values),
+            indices=payload.indices,
+            shape=payload.shape,
+            dtype=payload.dtype,
+        )

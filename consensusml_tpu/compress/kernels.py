@@ -308,3 +308,11 @@ class ChunkedTopKCompressor(Compressor):
         flat = jnp.zeros((n,), payload.dtype)
         flat = flat.at[payload.indices].add(jnp.asarray(payload.values, payload.dtype))
         return flat.reshape(payload.shape)
+
+    def decompress_accumulate(self, payload: TopKPayload, acc, weight):
+        """Fused scatter-add receive (padded-tail slots carry zero values,
+        so the duplicate index-0 entries add nothing — same semantics as
+        :meth:`decompress` + axpy, without the dense temporary)."""
+        flat = acc.reshape(-1)
+        vals = weight * jnp.asarray(payload.values, flat.dtype)
+        return flat.at[payload.indices].add(vals).reshape(acc.shape)

@@ -73,6 +73,14 @@ class TopKCompressor(Compressor):
         flat = flat.at[payload.indices].set(jnp.asarray(payload.values, payload.dtype))
         return flat.reshape(payload.shape)
 
+    def decompress_accumulate(self, payload: TopKPayload, acc, weight):
+        """Scatter-add the k weighted values directly into ``acc`` — the
+        fused decompress-accumulate path (no dense temporary; indices are
+        unique, so this matches dense decode + axpy exactly)."""
+        flat = acc.reshape(-1)
+        vals = weight * jnp.asarray(payload.values, flat.dtype)
+        return flat.at[payload.indices].add(vals).reshape(acc.shape)
+
 
 @dataclasses.dataclass(frozen=True)
 class Int8Compressor(Compressor):

@@ -239,10 +239,9 @@ class ConsensusEngine:
             recv = jax.tree.map(lambda d: topo.self_weight * d, dec_q)
             for shift in topo.shifts:
                 q_nbr = collectives.ppermute_shift_tree(q, topo, shift)
-                dec_nbr = comp.decompress_tree(q_nbr, like=delta)
-                recv = jax.tree.map(
-                    lambda r, d, w=shift.weight: r + w * d, recv, dec_nbr
-                )
+                # fused decompress-accumulate: sparse codecs scatter-add
+                # straight into recv — no dense per-neighbor temporary
+                recv = comp.decompress_accumulate_tree(q_nbr, recv, shift.weight)
         s = jax.tree.map(jnp.add, state.s, recv)
         x_new = jax.tree.map(
             lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat

@@ -111,3 +111,50 @@ def test_wire_bytes_accounting():
     assert wire < dense_bytes / 10
     q = Int8Compressor(chunk=256).wire_bytes((100, 100), jnp.float32)
     assert q == 10240 * 1 + 40 * 4  # padded int8 data + 40 f32 scales
+
+
+def test_decompress_accumulate_matches_dense_axpy():
+    """Fused receive == decompress + weighted add, for every codec family
+    (SURVEY.md §2 native component 3)."""
+    from consensusml_tpu.compress import (
+        ChunkedTopKCompressor,
+        IdentityCompressor,
+        PallasInt8Compressor,
+    )
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(37, 19)), jnp.float32)
+    acc = jnp.asarray(rng.normal(size=(37, 19)), jnp.float32)
+    codecs = [
+        TopKCompressor(ratio=0.1),
+        Int8Compressor(chunk=128),
+        topk_int8_compressor(ratio=0.2, chunk=128),
+        ChunkedTopKCompressor(chunk=128, k_per_chunk=4, impl="jnp"),
+        PallasInt8Compressor(chunk=128, impl="jnp"),
+        IdentityCompressor(),
+    ]
+    for comp in codecs:
+        p = comp.compress(x)
+        want = acc + 0.3 * jnp.asarray(comp.decompress(p), jnp.float32)
+        got = comp.decompress_accumulate(p, acc, 0.3)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=type(comp).__name__,
+        )
+
+
+def test_decompress_accumulate_tree():
+    comp = TopKCompressor(ratio=0.5)
+    rng = np.random.default_rng(8)
+    tree = {
+        "a": jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(3, 5)), jnp.float32),
+    }
+    acc = jax.tree.map(lambda v: jnp.asarray(rng.normal(size=v.shape), v.dtype), tree)
+    q = comp.compress_tree(tree)
+    want = jax.tree.map(
+        lambda a, d: a + 2.0 * d, acc, comp.decompress_tree(q, like=tree)
+    )
+    got = comp.decompress_accumulate_tree(q, acc, 2.0)
+    for ka in tree:
+        np.testing.assert_allclose(np.asarray(got[ka]), np.asarray(want[ka]), rtol=1e-6)
