@@ -19,3 +19,12 @@ from consensusml_tpu.data.native_pipeline import (  # noqa: F401
     native_lm_round_batches,
     native_round_batches,
 )
+from consensusml_tpu.data.files import (  # noqa: F401
+    FileClassification,
+    TokenFileDataset,
+    load_cifar10,
+    load_mnist,
+    load_tokens,
+    read_idx,
+    token_round_batches,
+)

@@ -1,0 +1,408 @@
+"""File-based dataset ingestion: real MNIST / CIFAR-10 / token corpora.
+
+Reference parity: BASELINE.json configs 1-5 name MNIST, CIFAR-10 and
+MLM/LM pretraining corpora; the north star's parity condition is
+"matching top-1 accuracy", which needs real data. This environment has no
+network, so these readers consume files a user drops into ``--data-dir``
+(nothing is downloaded); every config falls back to the procedural
+datasets in :mod:`consensusml_tpu.data.synthetic` when the files are
+absent. Formats are the standard on-disk layouts:
+
+- **MNIST**: idx ubyte files (``train-images-idx3-ubyte`` /
+  ``train-labels-idx1-ubyte`` + ``t10k-*`` for the held-out split),
+  optionally gzipped. Pixels normalized to [0, 1).
+- **CIFAR-10**: the binary batches (``data_batch_1..5.bin`` +
+  ``test_batch.bin``, 3073-byte records, CHW uint8), either directly in
+  ``data_dir`` or under ``cifar-10-batches-bin/``. Converted to NHWC f32.
+- **Token corpora**: a flat binary of token ids (``tokens.bin``, uint16
+  little-endian by default — the common memmapped-pretraining layout —
+  or uint32), with an optional ``tokens.val.bin`` held-out file. Sampling
+  draws random ``seq_len`` windows from the memmap; workers draw from
+  disjoint contiguous regions so replicas drift exactly as with the
+  procedural data.
+
+The classification readers duck-type :class:`SyntheticClassification`
+(``n`` / ``image_shape`` / ``worker_shard`` / ``holdout`` /
+``eval_batch``), and the token reader duck-types :class:`SyntheticLM`
+(``sample`` / ``vocab_size`` / ``seq_len`` / ``mask_token``), so the
+existing ``round_batches`` / ``lm_round_batches`` iterators — and the
+trainer above them — work unchanged on real files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import struct
+from typing import Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "FileClassification",
+    "TokenFileDataset",
+    "read_idx",
+    "load_mnist",
+    "load_cifar10",
+    "load_tokens",
+    "find_classification",
+    "find_tokens",
+]
+
+
+# ---------------------------------------------------------------------------
+# MNIST idx format
+# ---------------------------------------------------------------------------
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Read one idx-format array (the MNIST container format).
+
+    Handles ``.gz`` transparently. Layout: 4-byte magic (2 zero bytes,
+    dtype code, ndim), then ndim big-endian uint32 dims, then row-major
+    data.
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    zero, dtype_code, ndim = raw[0] << 8 | raw[1], raw[2], raw[3]
+    if zero != 0:
+        raise ValueError(f"{path}: bad idx magic {raw[:4]!r}")
+    dtypes = {
+        0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
+        0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"), 0x0E: np.dtype(">f8"),
+    }
+    if dtype_code not in dtypes:
+        raise ValueError(f"{path}: unknown idx dtype code {dtype_code:#x}")
+    dims = struct.unpack(f">{ndim}I", raw[4 : 4 + 4 * ndim])
+    data = np.frombuffer(raw, dtypes[dtype_code], offset=4 + 4 * ndim)
+    return data.reshape(dims)
+
+
+def _first_existing(data_dir: str, names: list[str]) -> str | None:
+    for name in names:
+        for cand in (name, name + ".gz"):
+            p = os.path.join(data_dir, cand)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+@dataclasses.dataclass
+class FileClassification:
+    """In-memory labeled image set with the SyntheticClassification API."""
+
+    images: np.ndarray  # (N, H, W, C) float32
+    labels: np.ndarray  # (N,) int32
+    holdout_images: np.ndarray | None = None
+    holdout_labels: np.ndarray | None = None
+    source: str = "file"
+
+    @property
+    def n(self) -> int:
+        return len(self.images)
+
+    @property
+    def image_shape(self) -> tuple[int, ...]:
+        return tuple(self.images.shape[1:])
+
+    @property
+    def classes(self) -> int:
+        return int(self.labels.max()) + 1
+
+    def worker_shard(self, rank: int, world_size: int) -> tuple[np.ndarray, np.ndarray]:
+        per = self.n // world_size
+        lo = rank * per
+        return self.images[lo : lo + per], self.labels[lo : lo + per]
+
+    def __post_init__(self):
+        # no test files on disk: carve the last 10% off the TRAIN set now,
+        # so worker_shard (which partitions self.images) can never hand a
+        # training worker data that later scores as "held-out"
+        if self.holdout_images is None:
+            cut = max(1, len(self.images) // 10)
+            self.holdout_images = self.images[-cut:]
+            self.holdout_labels = self.labels[-cut:]
+            self.images = self.images[:-cut]
+            self.labels = self.labels[:-cut]
+            self.source += ":tail-carved"
+
+    def holdout(self) -> "FileClassification":
+        """The dataset's test split (real held-out files when present, else
+        the tail carved off train at construction — never overlapping)."""
+        return FileClassification(
+            images=np.asarray(self.holdout_images),
+            labels=np.asarray(self.holdout_labels),
+            holdout_images=np.asarray(self.holdout_images),
+            holdout_labels=np.asarray(self.holdout_labels),
+            source=self.source + ":holdout",
+        )
+
+    def eval_batch(self, size: int = 1024) -> dict[str, jnp.ndarray]:
+        return {
+            "image": jnp.asarray(self.images[:size]),
+            "label": jnp.asarray(self.labels[:size]),
+        }
+
+
+def load_mnist(data_dir: str) -> FileClassification | None:
+    """MNIST from idx files in ``data_dir`` (or ``data_dir/mnist``)."""
+    for root in (data_dir, os.path.join(data_dir, "mnist")):
+        if not os.path.isdir(root):
+            continue
+        img_p = _first_existing(root, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"])
+        lab_p = _first_existing(root, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])
+        if img_p is None or lab_p is None:
+            continue
+        images = read_idx(img_p).astype(np.float32) / 255.0
+        labels = read_idx(lab_p).astype(np.int32)
+        images = images.reshape(*images.shape[:3], 1)  # (N, 28, 28, 1)
+        hi = _first_existing(root, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"])
+        hl = _first_existing(root, ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])
+        holdout_images = holdout_labels = None
+        if hi is not None and hl is not None:
+            holdout_images = read_idx(hi).astype(np.float32) / 255.0
+            holdout_images = holdout_images.reshape(*holdout_images.shape[:3], 1)
+            holdout_labels = read_idx(hl).astype(np.int32)
+        return FileClassification(
+            images=images,
+            labels=labels,
+            holdout_images=holdout_images,
+            holdout_labels=holdout_labels,
+            source=f"mnist:{root}",
+        )
+    return None
+
+
+def load_cifar10(data_dir: str) -> FileClassification | None:
+    """CIFAR-10 from the binary batch files."""
+    for root in (data_dir, os.path.join(data_dir, "cifar-10-batches-bin")):
+        if not os.path.isdir(root):
+            continue
+        train_paths = [
+            os.path.join(root, f"data_batch_{i}.bin") for i in range(1, 6)
+        ]
+        train_paths = [p for p in train_paths if os.path.exists(p)]
+        if not train_paths:
+            continue
+        imgs, labs = zip(*(_read_cifar_bin(p) for p in train_paths))
+        images, labels = np.concatenate(imgs), np.concatenate(labs)
+        holdout_images = holdout_labels = None
+        test_p = os.path.join(root, "test_batch.bin")
+        if os.path.exists(test_p):
+            holdout_images, holdout_labels = _read_cifar_bin(test_p)
+        return FileClassification(
+            images=images,
+            labels=labels,
+            holdout_images=holdout_images,
+            holdout_labels=holdout_labels,
+            source=f"cifar10:{root}",
+        )
+    return None
+
+
+def _read_cifar_bin(path: str) -> tuple[np.ndarray, np.ndarray]:
+    rec = 1 + 3 * 32 * 32
+    raw = np.fromfile(path, np.uint8)
+    if raw.size % rec:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of {rec}")
+    raw = raw.reshape(-1, rec)
+    labels = raw[:, 0].astype(np.int32)
+    # records are CHW; TPU wants NHWC
+    images = (
+        raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
+        / 255.0
+    )
+    return images, labels
+
+
+def find_classification(data_dir: str) -> FileClassification | None:
+    """Auto-detect MNIST or CIFAR-10 under ``data_dir``."""
+    return load_mnist(data_dir) or load_cifar10(data_dir)
+
+
+# ---------------------------------------------------------------------------
+# memmapped token corpora
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TokenFileDataset:
+    """Random ``seq_len`` windows over a memmapped flat token file.
+
+    Duck-types :class:`SyntheticLM`: ``sample(rng, shape)`` returns int32
+    ids of shape ``(*shape, seq_len)``. The highest id must be
+    ``< vocab_size - 1``: the last vocab slot stays reserved as [MASK]
+    (same convention as the procedural LM data).
+    """
+
+    tokens: np.ndarray  # 1-D memmap (or array) of token ids
+    seq_len: int
+    vocab_size: int
+    val_tokens: np.ndarray | None = None
+    source: str = "file"
+
+    def __post_init__(self):
+        if len(self.tokens) < self.seq_len + 1:
+            raise ValueError(
+                f"token file has {len(self.tokens)} tokens < seq_len+1="
+                f"{self.seq_len + 1}"
+            )
+        # no val file on disk: carve the last 5% off the TRAIN stream now,
+        # so training windows (drawn from self.tokens via worker_region)
+        # can never overlap the held-out region
+        if self.val_tokens is None:
+            cut = max(self.seq_len + 1, len(self.tokens) // 20)
+            if len(self.tokens) - cut >= self.seq_len + 1:
+                self.val_tokens = self.tokens[-cut:]
+                self.tokens = self.tokens[:-cut]
+                self.source += ":tail-carved"
+            else:  # file too small to carve — eval on train, loudly
+                self.val_tokens = self.tokens
+                self.source += ":eval-on-train"
+
+    @property
+    def mask_token(self) -> int:
+        return self.vocab_size - 1
+
+    def sample(self, rng: np.random.Generator, shape: tuple[int, ...]) -> np.ndarray:
+        return _sample_windows(self.tokens, rng, shape, self.seq_len)
+
+    def holdout(self) -> "TokenFileDataset":
+        """Held-out windows: the val file when present, else the tail
+        carved off the train stream at construction — never overlapping."""
+        return TokenFileDataset(
+            tokens=self.val_tokens,
+            seq_len=self.seq_len,
+            vocab_size=self.vocab_size,
+            val_tokens=self.val_tokens,
+            source=self.source + ":holdout",
+        )
+
+    def worker_region(self, rank: int, world_size: int) -> tuple[int, int]:
+        """Contiguous [lo, hi) token region for one worker's windows."""
+        per = len(self.tokens) // world_size
+        if per < self.seq_len + 1:
+            raise ValueError(
+                f"token stream too small for this world: {len(self.tokens)}"
+                f" train tokens / {world_size} workers = {per} per worker, "
+                f"need at least seq_len+1={self.seq_len + 1} each"
+            )
+        lo = rank * per
+        return lo, lo + per
+
+
+def _sample_windows(
+    tokens: np.ndarray, rng: np.random.Generator, shape: tuple[int, ...], seq_len: int
+) -> np.ndarray:
+    n = int(np.prod(shape))
+    starts = rng.integers(0, len(tokens) - seq_len, size=n)
+    out = np.empty((n, seq_len), np.int32)
+    for i, s in enumerate(starts):
+        out[i] = tokens[s : s + seq_len]
+    return out.reshape(*shape, seq_len)
+
+
+def _sniff_token_dtype(path: str, vocab_size: int):
+    """Distinguish uint16 from uint32 token files.
+
+    A uint32 file read as uint16 becomes alternating ``(id, 0)`` pairs
+    (little-endian, ids < 2^16) — every id still passes the vocab check,
+    so misreading is SILENT. Heuristic: probe the first 128 KiB; if the
+    file is 4-byte aligned and the odd uint16 positions are ~all zero
+    while even positions aren't, it is uint32. A vocab over 2^16 forces
+    uint32 outright.
+    """
+    if vocab_size > 1 << 16:
+        return np.uint32
+    size = os.path.getsize(path)
+    probe = np.fromfile(path, np.uint16, count=min(size // 2, 65536))
+    if size % 4 == 0 and probe.size >= 8:
+        odd, even = probe[1::2], probe[0::2]
+        if np.count_nonzero(odd) * 100 <= odd.size and np.count_nonzero(even):
+            return np.uint32
+    return np.uint16
+
+
+def load_tokens(
+    data_dir: str,
+    seq_len: int,
+    vocab_size: int,
+    *,
+    names: tuple[str, ...] = ("tokens.bin", "train.bin"),
+    dtype="auto",
+) -> TokenFileDataset | None:
+    """Memmap ``tokens.bin`` (+ optional ``tokens.val.bin`` / ``val.bin``).
+
+    ``dtype="auto"`` sniffs uint16 vs uint32 (see
+    :func:`_sniff_token_dtype`); pass an explicit dtype to override.
+    """
+    if not os.path.isdir(data_dir):
+        return None
+    for name in names:
+        p = os.path.join(data_dir, name)
+        if not os.path.exists(p):
+            continue
+        dt = _sniff_token_dtype(p, vocab_size) if dtype == "auto" else np.dtype(dtype)
+        toks = np.memmap(p, dtype=dt, mode="r")
+        stem = name.rsplit(".bin", 1)[0]
+        val = None
+        for vname in (f"{stem}.val.bin", "val.bin"):
+            vp = os.path.join(data_dir, vname)
+            if os.path.exists(vp):
+                val = np.memmap(vp, dtype=dt, mode="r")
+                break
+        return TokenFileDataset(
+            tokens=toks,
+            seq_len=seq_len,
+            vocab_size=vocab_size,
+            val_tokens=val,
+            source=f"tokens:{p}[{np.dtype(dt).name}]",
+        )
+    return None
+
+
+find_tokens = load_tokens
+
+
+# ---------------------------------------------------------------------------
+# round-batch iterator for token files (classification reuses round_batches)
+# ---------------------------------------------------------------------------
+
+
+def token_round_batches(
+    dataset: TokenFileDataset,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    mlm_rate: float = 0.0,
+    mask_token: int | None = None,
+    start: int = 0,
+) -> Iterator[dict]:
+    """Stacked ``(W, H, B, S)`` batches of file-token windows.
+
+    Worker ``r`` draws windows only from its contiguous token region, so
+    workers see disjoint data (replica drift, as with every other loader).
+    Keyed by (seed, absolute round, rank) for exact resume.
+    """
+    from consensusml_tpu.data.synthetic import mlm_corrupt
+
+    regions = [dataset.worker_region(r, world_size) for r in range(world_size)]
+    for r in range(start, start + rounds):
+        per_worker = []
+        for rank, (lo, hi) in enumerate(regions):
+            rng = np.random.default_rng((seed, r, rank))
+            per_worker.append(
+                _sample_windows(
+                    dataset.tokens[lo:hi], rng, (h, batch), dataset.seq_len
+                )
+            )
+        ids = np.stack(per_worker)
+        if mlm_rate <= 0:
+            yield {"input_ids": jnp.asarray(ids)}
+        else:
+            yield mlm_corrupt(ids, dataset, seed, r, mlm_rate, mask_token)

@@ -22,7 +22,7 @@ across eval batches:
 
 from __future__ import annotations
 
-import weakref
+import collections
 from typing import Any, Callable, Iterable
 
 import jax
@@ -118,7 +118,11 @@ def causal_lm_eval_fn(model, *, deterministic_kwarg: bool = True) -> EvalFn:
 # ---------------------------------------------------------------------------
 
 
-_EVAL_STEP_CACHE: "weakref.WeakKeyDictionary[Any, Any]" = weakref.WeakKeyDictionary()
+# Bounded LRU, not a WeakKeyDictionary: the cached jitted step closes over
+# eval_fn, so a weak-keyed entry could never be collected anyway (the value
+# would pin its own key). Eviction caps total pinned jit executables.
+_EVAL_STEP_CACHE: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+_EVAL_STEP_CACHE_MAX = 8
 
 
 def make_stacked_eval_step(eval_fn: EvalFn):
@@ -130,9 +134,9 @@ def make_stacked_eval_step(eval_fn: EvalFn):
     Returns ``(per_worker_sums, mean_model_sums)`` where per-worker leaves
     carry the ``(W,)`` axis.
 
-    Memoized per ``eval_fn`` (weakly, so closures don't leak) — repeated
-    :func:`evaluate` calls during training reuse one compiled step instead
-    of re-jitting each time.
+    Memoized per ``eval_fn`` (bounded LRU of {_EVAL_STEP_CACHE_MAX}) —
+    repeated :func:`evaluate` calls during training reuse one compiled
+    step instead of re-jitting each time.
 
     Note: the "mean model" is the UNWEIGHTED mean of the de-biased
     replicas. For push-sum runs this is not exactly the mass-weighted
@@ -141,6 +145,7 @@ def make_stacked_eval_step(eval_fn: EvalFn):
     """
     cached = _EVAL_STEP_CACHE.get(eval_fn)
     if cached is not None:
+        _EVAL_STEP_CACHE.move_to_end(eval_fn)
         return cached
 
     @jax.jit
@@ -155,6 +160,8 @@ def make_stacked_eval_step(eval_fn: EvalFn):
         return per, mean
 
     _EVAL_STEP_CACHE[eval_fn] = eval_step
+    while len(_EVAL_STEP_CACHE) > _EVAL_STEP_CACHE_MAX:
+        _EVAL_STEP_CACHE.popitem(last=False)
     return eval_step
 
 

@@ -43,6 +43,10 @@ def parse_args(argv=None):
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
+    p.add_argument("--data-dir", default=None,
+                   help="train on real files from this directory (MNIST idx / "
+                        "CIFAR-10 binaries / tokens.bin — see data.files); "
+                        "falls back to procedural data when absent")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -98,7 +102,7 @@ def main(argv=None) -> int:
 
     platform = jax.default_backend()
     scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
-    bundle = configs.build(args.config, scale)
+    bundle = configs.build(args.config, scale, data_dir=args.data_dir)
 
     if args.drop_prob > 0 or args.push_sum:
         import dataclasses
