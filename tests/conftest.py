@@ -17,5 +17,64 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# fast/slow test tiers
+#
+# The full suite takes ~18-20 min on an 8-device virtual CPU mesh (compile
+# cost dominates). The FAST tier — `pytest -m "not slow"` — finishes in a
+# few minutes and still touches every module's math. Tests measured >=5s
+# (pytest --durations on this box) are marked slow here centrally, so the
+# tier stays honest as timings drift: re-measure and edit this list.
+# Tests may also self-mark with @pytest.mark.slow.
+# ---------------------------------------------------------------------------
+
+_SLOW_TESTS = {
+    # hybrid/model-parallel cross-validation (shard_map compiles)
+    "test_llama_tp_matches_simulated", "test_gpt2_tp_rules_apply",
+    # ResNet full-model compiles + ring training
+    "test_config2_resnet_ring_training_smoke",
+    "test_resnet50_param_count_and_shapes",
+    "test_resnet_bn_state_updates_in_train_mode",
+    "test_resnet_cifar_stem_keeps_resolution",
+    # MoE (expert-parallel compiles)
+    "test_moe_ep_matches_simulated", "test_moe_local_sgd_trains",
+    "test_moe_forward_shapes_and_aux", "test_moe_interleave",
+    "test_moe_causality", "test_routing_no_drop_when_capacity_ample",
+    # codec convergence loops
+    "test_choco_converges_with_codec", "test_stochastic_codec_backends_agree",
+    # CLI subprocess runs (fresh interpreter + compile each)
+    "test_train_checkpoint_resume", "test_worker_single_process_forwards",
+    "test_train_mnist_end_to_end", "test_train_unknown_config",
+    "test_train_list", "test_train_requires_config",
+    # time-varying topology convergence
+    "test_onepeer_beats_ring_consensus_decay",
+    "test_choco_collective_matches_simulated_onepeer",
+    "test_symmetric_time_varying_with_faults_runs",
+    "test_onepeer_with_choco_compression_converges",
+    "test_collective_matches_simulated_onepeer",
+    # transformer configs (full forward/backward compiles)
+    "test_config5_gpt2_compressed_gossip", "test_config4_llama_lora_torus",
+    "test_config3_bert_local_sgd_h8", "test_bert_shapes",
+    "test_llama_forward_and_gqa", "test_lora_mask_selects_adapters_only",
+    # evaluation over stacked replicas
+    "test_lm_configs_expose_eval",
+    "test_evaluate_reports_per_worker_and_mean_model", "test_cli_eval",
+    # faults / outer-optimizer cross-validation
+    "test_collective_matches_simulated_under_dropout",
+    "test_collective_matches_simulated_slowmo",
+    "test_slowmo_converges_and_momentum_engages",
+    # CHOCO contraction sweeps
+    "test_choco_contracts_and_preserves_mean",
+    "test_choco_collective_matches_simulated",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
