@@ -113,6 +113,96 @@ def _inner(batch: int, steps: int, image: int) -> dict:
     }
 
 
+def _codec_bench() -> dict:
+    """Micro-bench the config-5 codec pair on this device: wire bytes and
+    one compress+decompress round, Pallas kernels vs jnp reference, on a
+    GPT-2-medium-sized leaf (4096x1024 f32 ~= the big MLP matrices)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("BENCH_DEVICE"):
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    from consensusml_tpu.compress import topk_int8_compressor
+
+    shape = (4096, 1024)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=shape), jnp.float32)
+    out = {"tensor": list(shape), "platform": jax.default_backend()}
+    for name, comp in [
+        ("pallas", topk_int8_compressor(chunk=512, k=8, impl="auto")),
+        ("jnp_reference", topk_int8_compressor(ratio=8 / 512, chunk=512)),
+    ]:
+        roundtrip = jax.jit(lambda v, c=comp: c.decompress(c.compress(v)))
+        r = roundtrip(x)
+        float(jnp.sum(r))  # fence (compile + first run)
+        t0 = time.time()
+        reps = 20
+        for _ in range(reps):
+            r = roundtrip(x)
+        s = float(jnp.sum(r))  # fence
+        out[name] = {
+            "roundtrip_ms": round(1000 * (time.time() - t0) / reps, 3),
+            "wire_bytes": comp.wire_bytes(shape, jnp.float32),
+            "checksum": round(s, 3),
+        }
+    dense = int(np.prod(shape)) * 4
+    out["dense_bytes"] = dense
+    out["compression_x"] = round(dense / out["pallas"]["wire_bytes"], 1)
+    return out
+
+
+def _consensus_bench() -> dict:
+    """The consensus-error half of the headline metric: ~20 rounds of the
+    8-worker ring on this process's devices (the driver subprocess forces
+    an 8-device virtual CPU mesh), reporting the error trajectory."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from consensusml_tpu.comm import WorkerMesh
+    from consensusml_tpu.consensus import GossipConfig
+    from consensusml_tpu.data import SyntheticClassification, round_batches
+    from consensusml_tpu.models import MLP, mlp_loss_fn
+    from consensusml_tpu.topology import RingTopology
+    from consensusml_tpu.train import (
+        LocalSGDConfig,
+        init_stacked_state,
+        make_collective_train_step,
+    )
+
+    world, rounds = 8, 20
+    topo = RingTopology(world)
+    wmesh = WorkerMesh.create(topo, devices=jax.devices()[:world])
+    model = MLP(hidden=32)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.sgd(0.05), h=1
+    )
+    step = make_collective_train_step(cfg, mlp_loss_fn(model), wmesh)
+    state = init_stacked_state(
+        cfg,
+        lambda r: model.init(r, jnp.zeros((1, 8, 8, 1)))["params"],
+        jax.random.key(0),
+        world,
+    )
+    state = wmesh.shard_stacked(state)
+    data = SyntheticClassification(n=512, image_shape=(8, 8, 1))
+    errs = []
+    for batch in round_batches(data, world, cfg.h, 8, rounds):
+        state, metrics = step(state, batch)
+        errs.append(float(metrics["consensus_error"]))
+    return {
+        "world": world,
+        "topology": "ring",
+        "rounds": rounds,
+        "consensus_error_first": round(errs[0], 4),
+        "consensus_error_last": round(errs[-1], 4),
+        "per_round_decay": round((errs[-1] / errs[0]) ** (1 / (rounds - 1)), 4),
+        "spectral_bound": round(1 - topo.spectral_gap(), 4),
+    }
+
+
 def main() -> None:
     if "--_inner" in sys.argv:
         batch = int(os.environ.get("BENCH_BATCH", "128"))
@@ -120,24 +210,37 @@ def main() -> None:
         image = int(os.environ.get("BENCH_IMAGE", "224"))
         print("INNER_RESULT " + json.dumps(_inner(batch, steps, image)), flush=True)
         return
+    if "--_codec" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_codec_bench()), flush=True)
+        return
+    if "--_consensus" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_consensus_bench()), flush=True)
+        return
 
     timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
-    try:
+
+    def run_sub(flag: str, timeout_s: float, extra_env: dict | None = None):
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--_inner"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
             text=True,
-            timeout=timeout,
+            timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
         )
-        result = None
         for line in proc.stdout.splitlines():
             if line.startswith("INNER_RESULT "):
-                result = json.loads(line[len("INNER_RESULT "):])
-        if result is None:
-            raise RuntimeError(
-                f"bench inner failed (rc={proc.returncode}): {proc.stderr[-800:]}"
-            )
+                return json.loads(line[len("INNER_RESULT "):])
+        raise RuntimeError(
+            f"bench {flag} failed (rc={proc.returncode}): {proc.stderr[-800:]}"
+        )
+
+    extras: dict = {}
+    try:
+        result = run_sub("--_inner", timeout)
         value = result["imgs_sec"]
         batch = int(os.environ.get("BENCH_BATCH", "128"))
         image = int(os.environ.get("BENCH_IMAGE", "224"))
@@ -151,6 +254,27 @@ def main() -> None:
     except (subprocess.TimeoutExpired, RuntimeError) as e:
         value = 0.0
         note = f"bench failed: {type(e).__name__}: {str(e)[:300]}"
+
+    # the consensus-error half of the headline metric (8-worker ring on a
+    # virtual CPU mesh — gossip collectives need >1 device) and the codec
+    # kernel micro-bench; failures are reported but never mask imgs/sec
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split() if "host_platform_device_count" not in f
+    )
+    try:
+        extras["consensus"] = run_sub(
+            "--_consensus",
+            600,
+            {"XLA_FLAGS": (flags + " --xla_force_host_platform_device_count=8").strip()},
+        )
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        extras["consensus"] = {"error": str(e)[:300]}
+    try:
+        extras["codec"] = run_sub("--_codec", 900)
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        extras["codec"] = {"error": str(e)[:300]}
+
     print(
         json.dumps(
             {
@@ -159,6 +283,7 @@ def main() -> None:
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(value / PROXY_BASELINE_IMGS_SEC_CHIP, 4),
                 "note": note,
+                **extras,
             }
         )
     )

@@ -139,25 +139,46 @@ def dequantize_int8(q: jax.Array, scales: jax.Array, *, interpret: bool = False)
 # ---------------------------------------------------------------------------
 
 
-def _topk_kernel(k: int, x_ref, vals_ref, idx_ref):
-    """Per row: k iterative max-|x| extractions (first index wins ties)."""
+def _topk_kernel(k: int, kpad: int, x_ref, vals_ref, idx_ref):
+    """Per row: k iterative max-|x| extractions (first index wins ties).
+
+    Results accumulate in REGISTERS (a (R, kpad) carry written by masked
+    selects) and are stored once as full aligned blocks at the end —
+    Mosaic rejects per-iteration single-column VMEM stores because a
+    dynamic lane offset can't be proven a multiple of the 128-lane tile
+    (caught on real-TPU compile; the interpreter doesn't model it).
+    """
     x = x_ref[:]  # (R, m) f32
     rows, m = x.shape
     col = jax.lax.broadcasted_iota(jnp.int32, (rows, m), 1)
+    colk = jax.lax.broadcasted_iota(jnp.int32, (rows, kpad), 1)
 
-    def body(j, x_abs):
+    def body(j, carry):
+        x_abs, vals, idxs = carry
         rowmax = jnp.max(x_abs, axis=1, keepdims=True)
         # first column index attaining the max
         hit = x_abs == rowmax
         idx = jnp.min(jnp.where(hit, col, m), axis=1, keepdims=True)  # (R,1)
         taken = col == idx
         val = jnp.sum(jnp.where(taken, x, 0.0), axis=1, keepdims=True)
-        vals_ref[:, pl.ds(j, 1)] = val
-        idx_ref[:, pl.ds(j, 1)] = idx
+        write = colk == j
+        vals = jnp.where(write, val, vals)  # (R,1) broadcasts over kpad
+        idxs = jnp.where(write, idx, idxs)
         # mask the taken column out for the next extraction
-        return jnp.where(taken, -1.0, x_abs)
+        return jnp.where(taken, -1.0, x_abs), vals, idxs
 
-    jax.lax.fori_loop(0, k, body, jnp.abs(x))
+    _, vals, idxs = jax.lax.fori_loop(
+        0,
+        k,
+        body,
+        (
+            jnp.abs(x),
+            jnp.zeros((rows, kpad), jnp.float32),
+            jnp.zeros((rows, kpad), jnp.int32),
+        ),
+    )
+    vals_ref[:] = vals
+    idx_ref[:] = idxs
 
 
 @functools.partial(jax.jit, static_argnames=("k", "interpret"))
@@ -176,7 +197,7 @@ def chunked_topk(chunks: jax.Array, k: int, *, interpret: bool = False):
         chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
     kpad = _round_up(k, _LANE)
     vals, idx = pl.pallas_call(
-        functools.partial(_topk_kernel, k),
+        functools.partial(_topk_kernel, k, kpad),
         grid=(rows // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM)

@@ -111,11 +111,37 @@ class Int8Compressor(Compressor):
         return flat[:n].astype(payload.dtype).reshape(payload.shape)
 
 
-def topk_int8_compressor(ratio: float = 0.01, chunk: int = 256, k: int | None = None):
+def topk_int8_compressor(
+    ratio: float = 0.01,
+    chunk: int = 256,
+    k: int | None = None,
+    impl: str = "reference",
+):
     """Config-5 codec: top-k sparsify, then int8-quantize the k values
-    (BASELINE.json configs[4])."""
+    (BASELINE.json configs[4]).
+
+    ``impl="reference"``: global exact top-k (``lax.top_k``) + jnp int8 —
+    the semantics oracle. ``impl="auto"|"pallas"|"interpret"|"jnp"``: the
+    Pallas-kernel-backed pair — PER-CHUNK top-k (``k_per_chunk =
+    round(ratio * chunk)`` winners per ``chunk`` elements, the layout that
+    keeps every candidate in VMEM) + the fused one-pass int8 kernel.
+    "auto" compiles the kernels on TPU and falls back to identical jnp
+    math elsewhere, so tests on the CPU mesh validate the exact semantics
+    the chip runs.
+    """
     from consensusml_tpu.compress.base import ComposedCompressor
 
+    if impl == "reference":
+        return ComposedCompressor(
+            inner=TopKCompressor(ratio=ratio, k=k), outer=Int8Compressor(chunk=chunk)
+        )
+    from consensusml_tpu.compress.kernels import (
+        ChunkedTopKCompressor,
+        PallasInt8Compressor,
+    )
+
+    k_per_chunk = k if k is not None else max(1, round(ratio * chunk))
     return ComposedCompressor(
-        inner=TopKCompressor(ratio=ratio, k=k), outer=Int8Compressor(chunk=chunk)
+        inner=ChunkedTopKCompressor(chunk=chunk, k_per_chunk=k_per_chunk, impl=impl),
+        outer=PallasInt8Compressor(chunk=max(chunk, 128), impl=impl),
     )

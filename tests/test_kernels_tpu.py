@@ -1,0 +1,92 @@
+"""On-hardware Pallas kernel parity (north star: "CUDA kernels become
+Pallas kernels").
+
+These tests run the COMPILED kernels on a real TPU chip and check
+numerics against the jnp reference math — the proof the interpreter-mode
+tests in test_kernels.py cannot give (e.g. Mosaic's lane-alignment rules
+only apply on real compiles; an earlier chunked_topk wrote one column per
+iteration and passed interpreter tests while failing TPU compilation).
+
+The suite conftest forces the CPU platform for the virtual 8-device mesh,
+so these tests run in a SUBPROCESS that re-enables the TPU; the whole
+module skips when no TPU is reachable. Run directly with:
+    pytest tests/test_kernels_tpu.py -m tpu
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]
+
+_CHILD = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() not in ("tpu", "axon"):
+    print(json.dumps({"skip": f"no TPU (backend={jax.default_backend()})"}))
+    raise SystemExit(0)
+
+from consensusml_tpu.compress.kernels import (
+    chunked_topk, dequantize_int8, quantize_int8,
+)
+from consensusml_tpu.compress.reference import chunk_for_quantization
+
+out = {"backend": jax.default_backend()}
+rng = np.random.default_rng(0)
+
+chunks = jnp.asarray(rng.normal(size=(1024, 512)), jnp.float32)
+q, s = quantize_int8(chunks)
+refc, refs, inv, _ = chunk_for_quantization(chunks, 512)
+q_ref = np.clip(
+    np.rint(np.asarray(refc) * np.asarray(inv)[:, None]), -127, 127
+).astype(np.int8)
+out["quant_exact"] = bool(np.array_equal(np.asarray(q), q_ref))
+out["scales_exact"] = bool(np.allclose(np.asarray(s), np.asarray(refs)))
+d = dequantize_int8(q, s)
+out["dequant_exact"] = bool(
+    np.allclose(np.asarray(d), np.asarray(q, np.float32) * np.asarray(s)[:, None])
+)
+
+ok_topk = True
+for rows, cols, k in [(1024, 512, 16), (37, 256, 5), (8, 128, 128)]:
+    c = jnp.asarray(rng.normal(size=(rows, cols)), jnp.float32)
+    v, i = chunked_topk(c, k)
+    _, li = jax.lax.top_k(jnp.abs(c), k)
+    vref = np.take_along_axis(np.asarray(c), np.asarray(li), axis=1)
+    ok_topk &= bool(np.array_equal(np.asarray(i), np.asarray(li)))
+    ok_topk &= bool(np.allclose(np.asarray(v), vref))
+out["topk_exact"] = ok_topk
+print(json.dumps(out))
+"""
+
+
+def test_pallas_kernels_match_reference_on_tpu():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if "xla_force_host_platform_device_count" not in v or k != "XLA_FLAGS"
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=repo,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    result = json.loads(line)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["quant_exact"], result
+    assert result["scales_exact"], result
+    assert result["dequant_exact"], result
+    assert result["topk_exact"], result
