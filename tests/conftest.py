@@ -51,6 +51,11 @@ _SLOW_TESTS = {
     "test_train_checkpoint_resume", "test_worker_single_process_forwards",
     "test_train_mnist_end_to_end", "test_train_unknown_config",
     "test_train_list", "test_train_requires_config",
+    "test_train_llama_lora_model_axes_tp2",
+    "test_train_model_axes_rejected_without_rules",
+    "test_train_model_axes_bad_syntax",
+    "test_train_model_axes_multi_axis_rejected",
+    "test_train_model_axes_zero_rejected",
     # time-varying topology convergence
     "test_onepeer_beats_ring_consensus_decay",
     "test_choco_collective_matches_simulated_onepeer",

@@ -79,3 +79,52 @@ def test_worker_single_process_forwards():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final: loss=" in r.stdout
+
+
+def test_train_llama_lora_model_axes_tp2():
+    """Hybrid gossip-DP x tensor-parallel reachable from the CLI: 2x2
+    torus of workers, each a tp=2 submesh (8 virtual devices total)."""
+    r = _run(
+        ["train.py", "--config", "llama_lora", "--device", "cpu",
+         "--rounds", "2", "--model-axes", "tp=2"],
+        timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "model_axes=tp=2" in r.stdout
+    assert "final:" in r.stdout
+
+
+def test_train_model_axes_rejected_without_rules():
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "1", "--model-axes", "tp=2"],
+    )
+    assert r.returncode == 2
+    assert "no model-sharding rules" in r.stderr
+
+
+def test_train_model_axes_bad_syntax():
+    r = _run(
+        ["train.py", "--config", "llama_lora", "--device", "cpu",
+         "--rounds", "1", "--model-axes", "tp-two"],
+    )
+    assert r.returncode == 2
+    assert "bad --model-axes" in r.stderr
+
+
+def test_train_model_axes_multi_axis_rejected():
+    r = _run(
+        ["train.py", "--config", "llama_lora", "--device", "cpu",
+         "--rounds", "1", "--model-axes", "tp=2,ep=2"],
+    )
+    assert r.returncode == 2
+    assert "single axis" in r.stderr
+
+
+def test_train_model_axes_zero_rejected():
+    r = _run(
+        ["train.py", "--config", "llama_lora", "--device", "cpu",
+         "--rounds", "1", "--model-axes", "tp=0"],
+    )
+    assert r.returncode == 2
+    assert "sizes must be" in r.stderr

@@ -28,6 +28,12 @@ def parse_args(argv=None):
     p.add_argument("--config", default=None, help="workload name (see --list)")
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"],
                    help="backend platform; cpu simulates workers on host devices")
+    p.add_argument("--model-axes", default=None,
+                   help='hybrid model parallelism for the collective backend: '
+                        '"tp=N" gives every worker an N-device submesh with '
+                        'params sharded per the config\'s TP rules (one axis '
+                        'only from the CLI); "none" disables a config\'s '
+                        'default (full-scale llama_lora defaults to tp=4)')
     p.add_argument("--backend", default="auto", choices=["auto", "collective", "simulated"],
                    help="collective = shard_map over a device mesh; simulated = "
                         "stacked workers on one device (CPU reference mode)")
@@ -135,17 +141,88 @@ def main(argv=None) -> int:
             bundle.cfg, outer=SlowMoConfig(beta=args.slowmo_beta)
         )
 
+    model_axes = bundle.model_axes
+    user_set_axes = args.model_axes is not None
+    if user_set_axes:
+        if args.model_axes.strip().lower() in ("none", ""):
+            model_axes = ()
+        else:
+            try:
+                model_axes = tuple(
+                    (kv.split("=")[0].strip(), int(kv.split("=")[1]))
+                    for kv in args.model_axes.split(",")
+                )
+            except (IndexError, ValueError):
+                print(
+                    f'error: bad --model-axes {args.model_axes!r} '
+                    '(expected e.g. "tp=2" or "none")',
+                    file=sys.stderr,
+                )
+                return 2
+            if any(s < 1 for _, s in model_axes):
+                print(
+                    f'error: bad --model-axes {args.model_axes!r} '
+                    "(axis sizes must be >= 1)",
+                    file=sys.stderr,
+                )
+                return 2
+            if len(model_axes) > 1:
+                # a config's tp_rules shard over ONE axis; silently
+                # replicating over the extra axes would burn devices
+                print(
+                    "error: --model-axes supports a single axis from the "
+                    'CLI (got "' + args.model_axes + '"); multi-axis '
+                    "hybrid runs need a config with explicit rules "
+                    "(see WorkerMesh.create + parallel.sharding)",
+                    file=sys.stderr,
+                )
+                return 2
+    if model_axes and bundle.tp_rules is None:
+        print(
+            f"error: config {bundle.name} has no model-sharding rules; "
+            "--model-axes is not supported for it",
+            file=sys.stderr,
+        )
+        return 2
+    per_worker = 1
+    for _, s in model_axes:
+        per_worker *= s
+    if (
+        model_axes
+        and not user_set_axes
+        and len(jax.devices()) < bundle.world_size * per_worker
+    ):
+        # the config's DEFAULT submesh doesn't fit this host — drop it and
+        # continue rather than failing on a flag the user never passed
+        axes_str = ",".join(f"{n}={s}" for n, s in model_axes)
+        print(
+            f"note: dropping config default model_axes={axes_str} "
+            f"(needs {bundle.world_size}x{per_worker} devices, have "
+            f"{len(jax.devices())}); pass --model-axes to force",
+            flush=True,
+        )
+        model_axes = ()
+        per_worker = 1
+
     backend = args.backend
     if backend == "auto":
         backend = (
             "collective"
-            if len(jax.devices()) >= bundle.world_size
+            if len(jax.devices()) >= bundle.world_size * per_worker
             else "simulated"
         )
+    if backend == "simulated" and model_axes:
+        print(
+            "error: --model-axes needs the collective backend "
+            f"({bundle.world_size}x{per_worker} devices)",
+            file=sys.stderr,
+        )
+        return 2
+    axes_str = ",".join(f"{n}={s}" for n, s in model_axes) or "-"
     print(
         f"config={bundle.name} scale={scale} platform={platform} "
-        f"backend={backend} workers={bundle.world_size} h={bundle.cfg.h}: "
-        f"{bundle.description}",
+        f"backend={backend} workers={bundle.world_size} h={bundle.cfg.h} "
+        f"model_axes={axes_str}: {bundle.description}",
         flush=True,
     )
 
@@ -153,9 +230,14 @@ def main(argv=None) -> int:
         bundle.cfg, bundle.init_params, jax.random.key(args.seed), bundle.world_size
     )
     if backend == "collective":
-        wmesh = WorkerMesh.create(bundle.cfg.gossip.topology)
+        wmesh = WorkerMesh.create(
+            bundle.cfg.gossip.topology, model_axes=model_axes
+        )
         step = make_collective_train_step(bundle.cfg, bundle.loss_fn, wmesh)
-        state = wmesh.shard_stacked(state)
+        rules = (
+            bundle.tp_rules(model_axes[0][0]) if model_axes else None
+        )
+        state = wmesh.shard_stacked(state, rules=rules)
     else:
         step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
 
