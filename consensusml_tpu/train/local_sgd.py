@@ -312,6 +312,10 @@ def make_collective_train_step(
         with jax.sharding.set_mesh(wmesh.mesh):
             return jitted_step(state, batch)
 
+    # the underlying jit object, for .lower()/AOT inspection (full-scale
+    # shape smoke tests trace without executing); callers must set the
+    # ambient mesh themselves when using it directly
+    train_step._jitted = jitted_step
     return train_step
 
 

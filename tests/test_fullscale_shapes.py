@@ -1,0 +1,147 @@
+"""Full-scale configs trace + shard without execution (VERDICT weak #5).
+
+The 1-chip box can never RUN `bert_mlm` full (world 32, BERT-base) or
+`llama_lora` full (4x4 torus x tp=4 = 64 devices, Llama-2-7B), but
+shape/sharding-rule bugs in them are catchable: build the real full-scale
+bundle, `jax.eval_shape` the stacked state (no buffers materialize), bind
+it to a 64-device virtual CPU mesh with the config's sharding rules, and
+`.lower()` the actual collective train step — tracing + SPMD partitioning
+with zero FLOPs. Runs in a subprocess because the suite conftest pins the
+8-device mesh.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+flags = " ".join(f for f in flags.split() if "device_count" not in f)
+os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=64").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from consensusml_tpu import configs
+from consensusml_tpu.comm import WorkerMesh
+from consensusml_tpu.train import init_stacked_state, make_collective_train_step
+
+out = {}
+
+
+def lower_one(name, model_axes, rules, batch_maker):
+    bundle = configs.build(name, "full")
+    world = bundle.world_size
+    per = 1
+    for _, s in model_axes:
+        per *= s
+    wmesh = WorkerMesh.create(
+        bundle.cfg.gossip.topology,
+        devices=jax.devices()[: world * per],
+        model_axes=model_axes,
+    )
+    state_sds = jax.eval_shape(
+        lambda k: init_stacked_state(
+            bundle.cfg, bundle.init_params, k, world
+        ),
+        jax.random.key(0),
+    )
+    shardings = wmesh.stacked_shardings(state_sds, rules=rules)
+    state_in = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state_sds,
+        shardings,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    batch_sds = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=wmesh.stacked_sharding()
+        ),
+        batch_maker(bundle),
+    )
+    step = make_collective_train_step(bundle.cfg, bundle.loss_fn, wmesh)
+    jitted = getattr(step, "_jitted", step)
+    with jax.sharding.set_mesh(wmesh.mesh):
+        lowered = jitted.lower(state_in, batch_sds)
+    text = lowered.as_text()
+    return state_in, {"hlo_len": len(text), "world": world, "per_worker": per}
+
+
+# ---- bert_mlm full: 32-worker ring, BERT-base, no model axes ----
+def bert_batch(bundle):
+    b = bundle  # (W, H, B, S) int32 MLM triple — shapes only, no sampling
+    return {
+        "input_ids": jax.ShapeDtypeStruct((32, 8, 32, 128), jnp.int32),
+        "labels": jax.ShapeDtypeStruct((32, 8, 32, 128), jnp.int32),
+        "mlm_mask": jax.ShapeDtypeStruct((32, 8, 32, 128), jnp.float32),
+    }
+
+
+state_in, info = lower_one("bert_mlm", (), None, bert_batch)
+# every leaf shards its leading worker axis 32-way
+leaf = jax.tree.leaves(state_in.params)[0]
+info["param0_global"] = list(leaf.shape)
+info["param0_shard"] = list(leaf.sharding.shard_shape(leaf.shape))
+assert info["param0_shard"][0] == 1 and info["param0_global"][0] == 32
+out["bert_mlm"] = info
+
+# ---- llama_lora full: 4x4 torus x tp=4 (64 devices), 7B weights ----
+from consensusml_tpu.parallel import llama_tp_rules
+
+
+def llama_batch(bundle):
+    return {"input_ids": jax.ShapeDtypeStruct((16, 1, 8, 2048), jnp.int32)}
+
+
+state_in, info = lower_one(
+    "llama_lora", (("tp", 4),), llama_tp_rules("tp"), llama_batch
+)
+flat = jax.tree_util.tree_flatten_with_path(state_in.params)[0]
+def find(frag):
+    for p, leaf in flat:
+        if frag in jax.tree_util.keystr(p, simple=True, separator="/"):
+            return leaf
+    raise KeyError(frag)
+
+emb = find("tok_emb/embedding")
+info["emb_global"] = list(emb.shape)
+info["emb_shard"] = list(emb.sharding.shard_shape(emb.shape))
+# (16, 32000, 4096) -> one worker, hidden split 4-way
+assert info["emb_shard"] == [1, emb.shape[1], emb.shape[2] // 4], info
+q = find("q_proj/base/kernel")
+info["q_shard"] = list(q.sharding.shard_shape(q.shape))
+assert info["q_shard"] == [1, q.shape[1], q.shape[2] // 4], info
+down = find("down_proj/kernel")
+info["down_shard"] = list(down.sharding.shard_shape(down.shape))
+assert info["down_shard"] == [1, down.shape[1] // 4, down.shape[2]], info
+out["llama_lora"] = info
+
+print("RESULT " + json.dumps(out))
+"""
+
+
+def test_fullscale_bert_and_llama_tp_lower():
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True,
+        text=True,
+        timeout=1800,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stderr[-2500:], proc.stdout[-500:])
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")][-1]
+    out = json.loads(line[len("RESULT "):])
+    assert out["bert_mlm"]["hlo_len"] > 1000
+    assert out["llama_lora"]["hlo_len"] > 1000
+    assert out["llama_lora"]["per_worker"] == 4
