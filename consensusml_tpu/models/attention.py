@@ -14,7 +14,19 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["dot_product_attention", "apply_rope", "rope_frequencies"]
+__all__ = [
+    "dot_product_attention",
+    "blockwise_attention",
+    "apply_rope",
+    "rope_frequencies",
+]
+
+_NEG_INF = -1e30
+
+# auto dispatch: above this many logits per (batch, head) the dense S x T
+# f32 score matrix dominates activation memory and the blockwise path wins
+_BLOCKWISE_THRESHOLD = 512 * 512
+_DEFAULT_BLOCK_KV = 512
 
 
 def dot_product_attention(
@@ -25,13 +37,26 @@ def dot_product_attention(
     causal: bool = False,
     bias: jax.Array | None = None,
     dtype: Any = jnp.bfloat16,
+    impl: str = "auto",
 ) -> jax.Array:
-    """Standard multi-head attention with f32 logits/softmax.
+    """Multi-head attention with f32 logits/softmax.
 
-    Logits accumulate in f32 on the MXU (``preferred_element_type``), the
-    softmax runs in f32 for numerical stability, and the output returns to
-    ``dtype`` — the canonical TPU mixed-precision attention recipe.
+    ``impl``: "dense" materializes the (B, H, S, T) score matrix — fine
+    for short sequences; "blockwise" streams KV blocks with an online
+    softmax (flash-attention recurrence, O(S) activation memory) — what
+    the full-scale GPT-2 (seq 1024) and Llama (seq 2048) configs need;
+    "auto" picks blockwise once S*T crosses the dense threshold. Both
+    paths share the recipe: logits accumulate in f32 on the MXU
+    (``preferred_element_type``), softmax in f32, output in ``dtype``.
     """
+    if impl == "auto":
+        impl = (
+            "blockwise"
+            if q.shape[1] * k.shape[1] > _BLOCKWISE_THRESHOLD
+            else "dense"
+        )
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, bias=bias, dtype=dtype)
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     logits = jnp.einsum(
@@ -42,12 +67,103 @@ def dot_product_attention(
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), jnp.bool_), k=t - s)
-        logits = jnp.where(mask, logits, jnp.asarray(-1e30, jnp.float32))
+        logits = jnp.where(mask, logits, jnp.asarray(_NEG_INF, jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bhst,bthd->bshd", probs.astype(dtype), v, preferred_element_type=jnp.float32
     )
     return out.astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,  # (B, T, H, D)
+    v: jax.Array,  # (B, T, H, D)
+    *,
+    causal: bool = False,
+    bias: jax.Array | None = None,
+    dtype: Any = jnp.bfloat16,
+    block_kv: int = _DEFAULT_BLOCK_KV,
+) -> jax.Array:
+    """Exact attention that never materializes the full score matrix.
+
+    ``lax.scan`` over KV blocks with the flash-attention online-softmax
+    recurrence (running row max / row sum in f32) — the single-device
+    sibling of :func:`consensusml_tpu.parallel.ring_attention`, which runs
+    the same recurrence with ``ppermute`` rotations across a mesh axis.
+    Peak activation memory is O(S * block_kv) instead of O(S * T); XLA
+    fuses each block's mask+softmax+matmul chain.
+
+    ``bias`` must broadcast against ``(B, H, S, T)``; it is sliced along
+    T per block (BERT's padding bias ``(B, 1, 1, T)`` and full score
+    biases both work).
+    """
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    block_kv = min(block_kv, t)
+    nblk = -(-t // block_kv)
+    pad = nblk * block_kv - t
+
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (nblk, B, block, H, D) — scan carries one block at a time
+    kb = jnp.moveaxis(kp.reshape(b, nblk, block_kv, h, d), 1, 0)
+    vb = jnp.moveaxis(vp.reshape(b, nblk, block_kv, h, d), 1, 0)
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            jnp.asarray(bias, jnp.float32),
+            jnp.broadcast_shapes(bias.shape, (b, 1, 1, t)),
+        )
+        bp = jnp.pad(bias, [(0, 0)] * (bias.ndim - 1) + [(0, pad)])
+        # (nblk, B, Hb, Sb, block) with Hb/Sb possibly 1 (broadcast dims)
+        bb = jnp.moveaxis(
+            bp.reshape(*bp.shape[:-1], nblk, block_kv), -2, 0
+        )
+    else:
+        bb = None
+
+    pos_q = jnp.arange(s) + (t - s if causal else 0)  # absolute query rows
+
+    def step(carry, blk):
+        out, row_max, row_sum, start = carry
+        k_t, v_t, b_t = blk
+        logits = (
+            jnp.einsum("bshd,bthd->bhst", q, k_t, preferred_element_type=jnp.float32)
+            * scale
+        )
+        if b_t is not None:
+            logits = logits + b_t
+        pos_k = start + jnp.arange(block_kv)
+        valid = pos_k < t  # padded tail keys never contribute
+        if causal:
+            valid = valid[None, :] & (pos_q[:, None] >= pos_k[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (s, block_kv))
+        logits = jnp.where(valid[None, None], logits, _NEG_INF)
+        blk_max = jnp.max(logits, axis=-1)  # (B, H, S)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(logits - new_max[..., None])
+        new_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+        blk_out = jnp.einsum(
+            "bhst,bthd->bshd", probs, jnp.asarray(v_t, jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        new_out = out * correction.transpose(0, 2, 1)[..., None] + blk_out
+        return (new_out, new_max, new_sum, start + block_kv), None
+
+    carry0 = (
+        jnp.zeros((b, s, h, d), jnp.float32),
+        jnp.full((b, h, s), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s), jnp.float32),
+        jnp.asarray(0, jnp.int32),
+    )
+    (out, _, row_sum, _), _ = jax.lax.scan(
+        step, carry0, (kb, vb, bb) if bb is not None else (kb, vb, None)
+    )
+    denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    return (out / denom).astype(dtype)
 
 
 def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> jax.Array:

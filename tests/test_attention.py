@@ -1,0 +1,109 @@
+"""Blockwise (flash-style) attention parity vs the dense reference
+(VERDICT weak #7: long-seq configs need O(S) activation memory)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.models.attention import (
+    blockwise_attention,
+    dot_product_attention,
+)
+
+
+def _qkv(rng, b, s, t, h, d, dtype=jnp.float32):
+    return (
+        jnp.asarray(rng.normal(size=(b, s, h, d)), dtype),
+        jnp.asarray(rng.normal(size=(b, t, h, d)), dtype),
+        jnp.asarray(rng.normal(size=(b, t, h, d)), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,block", [(64, 16), (60, 16), (33, 64)])
+def test_blockwise_matches_dense(causal, t, block):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, 2, t, t, 3, 8)
+    want = dot_product_attention(q, k, v, causal=causal, dtype=jnp.float32, impl="dense")
+    got = blockwise_attention(q, k, v, causal=causal, dtype=jnp.float32, block_kv=block)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_blockwise_cross_attention_rectangular():
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, 2, 7, 45, 2, 8)
+    want = dot_product_attention(q, k, v, dtype=jnp.float32, impl="dense")
+    got = blockwise_attention(q, k, v, dtype=jnp.float32, block_kv=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_blockwise_causal_suffix_queries():
+    # s < t with causal: queries are the LAST s positions (decode-style)
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, 1, 5, 32, 2, 8)
+    want = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense")
+    got = blockwise_attention(q, k, v, causal=True, dtype=jnp.float32, block_kv=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_blockwise_padding_bias():
+    # BERT-style (B, 1, 1, T) padding bias
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, 2, 24, 24, 2, 8)
+    mask = (rng.random((2, 24)) > 0.3).astype(np.float32)
+    bias = jnp.where(jnp.asarray(mask)[:, None, None, :] > 0, 0.0, -1e30)
+    want = dot_product_attention(q, k, v, bias=bias, dtype=jnp.float32, impl="dense")
+    got = blockwise_attention(q, k, v, bias=bias, dtype=jnp.float32, block_kv=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_auto_dispatch_threshold():
+    rng = np.random.default_rng(4)
+    q, k, v = _qkv(rng, 1, 1024, 1024, 1, 8, jnp.bfloat16)
+    # auto at seq 1024 must agree with the explicit blockwise path bit-for-bit
+    auto = dot_product_attention(q, k, v, causal=True)
+    blk = dot_product_attention(q, k, v, causal=True, impl="blockwise")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(blk))
+
+
+def test_blockwise_memory_vs_dense():
+    """The point of the exercise: dense peak temp memory carries the full
+    (B, H, S, S) f32 score matrix; blockwise must not."""
+    b, s, h, d = 1, 2048, 4, 16
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    dense_c = (
+        jax.jit(lambda q: dot_product_attention(q, q, q, causal=True, impl="dense"))
+        .lower(q).compile()
+    )
+    blk_c = (
+        jax.jit(lambda q: dot_product_attention(q, q, q, causal=True, impl="blockwise"))
+        .lower(q).compile()
+    )
+    try:
+        dense_tmp = dense_c.memory_analysis().temp_size_in_bytes
+        blk_tmp = blk_c.memory_analysis().temp_size_in_bytes
+    except (AttributeError, NotImplementedError):
+        pytest.skip("memory_analysis unsupported on this backend")
+    score_bytes = b * h * s * s * 4
+    assert dense_tmp >= score_bytes  # sanity: dense really pays S^2
+    # blockwise must beat the score matrix and stay well under dense peak
+    # (measured here: ~35 MB vs dense ~136 MB at S=2048)
+    assert blk_tmp < score_bytes, (dense_tmp, blk_tmp)
+    assert blk_tmp < dense_tmp / 2, (dense_tmp, blk_tmp)
+
+
+def test_gpt2_fullseq_forward_uses_blockwise_without_oom():
+    """Full-scale GPT-2 seq length through the model path (layers=1 to
+    keep runtime sane; the attention shape is what matters)."""
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    model = GPT2LM(
+        config=GPT2Config(
+            vocab_size=128, hidden=64, layers=1, heads=4, max_len=1024, dropout=0.0
+        )
+    )
+    ids = jnp.zeros((1, 1024), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    logits = model.apply({"params": params}, ids, deterministic=True)
+    assert logits.shape == (1, 1024, 128)
