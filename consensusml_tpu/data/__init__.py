@@ -16,6 +16,8 @@ from consensusml_tpu.data.synthetic import (  # noqa: F401
     round_batches,
 )
 from consensusml_tpu.data.native_pipeline import (  # noqa: F401
+    native_file_round_batches,
+    native_file_token_batches,
     native_lm_round_batches,
     native_round_batches,
 )

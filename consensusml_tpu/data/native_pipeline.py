@@ -26,7 +26,12 @@ from consensusml_tpu.data.synthetic import (
     mlm_corrupt,
 )
 
-__all__ = ["native_round_batches", "native_lm_round_batches"]
+__all__ = [
+    "native_round_batches",
+    "native_lm_round_batches",
+    "native_file_round_batches",
+    "native_file_token_batches",
+]
 
 
 def native_round_batches(
@@ -101,6 +106,95 @@ def native_lm_round_batches(
         sample_ints=dataset.seq_len,
         nclasses_or_vocab=dataset.vocab_size,
         successors=dataset.successors,
+        depth=depth,
+        nthreads=nthreads,
+        seed=seed,
+    ) as loader:
+        for r in range(rounds):
+            _, ints = loader.next()
+            ids = ints.reshape(world_size, h, batch, dataset.seq_len)
+            if mlm_rate <= 0:
+                yield {"input_ids": jnp.asarray(ids)}
+            else:
+                yield mlm_corrupt(ids, dataset, seed, r, mlm_rate, mask_token)
+
+
+def native_file_round_batches(
+    dataset,  # data.files.FileClassification
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    depth: int = 4,
+    nthreads: int = 2,
+):
+    """File-backed classification batches through the C++ prefetch ring.
+
+    Producer threads do the per-sample gather from the in-memory image
+    table (worker shards = the same contiguous blocks worker_shard uses),
+    so --data-dir training overlaps batch assembly with device compute.
+    Deterministic in ``seed``; the sampled indices differ from the Python
+    path's numpy draws (documented divergence, as with the procedural
+    kinds).
+    """
+    import jax.numpy as jnp
+
+    from consensusml_tpu.native import NativeLoader
+
+    sample_floats = int(np.prod(dataset.image_shape))
+    per_slot = world_size * h * batch
+    with NativeLoader(
+        kind="file_classification",
+        samples_per_slot=per_slot,
+        sample_floats=sample_floats,
+        sample_ints=1,
+        world=world_size,
+        images=dataset.images.reshape(dataset.n, sample_floats),
+        labels=dataset.labels,
+        depth=depth,
+        nthreads=nthreads,
+        seed=seed,
+    ) as loader:
+        for _ in range(rounds):
+            floats, ints = loader.next()
+            yield {
+                "image": jnp.asarray(
+                    floats.reshape(world_size, h, batch, *dataset.image_shape)
+                ),
+                "label": jnp.asarray(ints.reshape(world_size, h, batch)),
+            }
+
+
+def native_file_token_batches(
+    dataset,  # data.files.TokenFileDataset
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    seed: int = 0,
+    mlm_rate: float = 0.0,
+    mask_token: int | None = None,
+    depth: int = 4,
+    nthreads: int = 2,
+):
+    """Token-window batches through the C++ prefetch ring (kind 3): each
+    producer thread memcpys seq_len windows from its worker's contiguous
+    token region. MLM corruption stays host-side numpy, keyed by
+    (seed, round) like every other loader."""
+    import jax.numpy as jnp
+
+    from consensusml_tpu.native import NativeLoader
+
+    per_slot = world_size * h * batch
+    with NativeLoader(
+        kind="file_lm",
+        samples_per_slot=per_slot,
+        sample_floats=0,
+        sample_ints=dataset.seq_len,
+        world=world_size,
+        # uint16 memmaps pass through uncopied (C++ widens per window)
+        tokens=dataset.tokens,
         depth=depth,
         nthreads=nthreads,
         seed=seed,

@@ -64,36 +64,56 @@ def _load() -> ctypes.CDLL | None:
             if not os.path.exists(_LIB_PATH):
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
-        except (OSError, subprocess.SubprocessError) as e:
+            try:
+                _declare(lib)
+            except AttributeError:
+                # a prebuilt .so from an older checkout lacks new symbols —
+                # rebuild once and re-dlopen (g++ -o replaces the inode, so
+                # the fresh dlopen sees the new library)
+                _build()
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+        except (OSError, subprocess.SubprocessError, AttributeError) as e:
             # keep the compiler's stderr — without it a failed `make` is
-            # undebuggable from the raised message alone
+            # undebuggable from the raised message alone; AttributeError =
+            # missing symbol even after rebuild, so fall back to Python
             detail = getattr(e, "stderr", None)
             _load_failed = f"{type(e).__name__}: {e}" + (
                 f"\n--- build stderr ---\n{detail}" if detail else ""
             )
             return None
-        lib.cml_quant_int8.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _i8p, _f32p]
-        lib.cml_dequant_int8.argtypes = [_i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p]
-        lib.cml_topk.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p]
-        lib.cml_topk_chunks.argtypes = [
-            _f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p,
-        ]
-        lib.cml_loader_create.argtypes = [
-            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
-            ctypes.c_float, _f32p, _i32p,
-        ]
-        lib.cml_loader_create.restype = ctypes.c_void_p
-        lib.cml_loader_acquire.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(_f32p), ctypes.POINTER(_i32p),
-        ]
-        lib.cml_loader_acquire.restype = ctypes.c_int
-        lib.cml_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.cml_loader_produced.argtypes = [ctypes.c_void_p]
-        lib.cml_loader_produced.restype = ctypes.c_uint64
-        lib.cml_loader_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    """Bind argtypes; raises AttributeError if any symbol is missing."""
+    lib.cml_quant_int8.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _i8p, _f32p]
+    lib.cml_dequant_int8.argtypes = [_i8p, _f32p, ctypes.c_int64, ctypes.c_int64, _f32p]
+    lib.cml_topk.argtypes = [_f32p, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p]
+    lib.cml_topk_chunks.argtypes = [
+        _f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _f32p, _i32p,
+    ]
+    lib.cml_loader_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_float, _f32p, _i32p,
+    ]
+    lib.cml_loader_create.restype = ctypes.c_void_p
+    lib.cml_loader_create_file.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.cml_loader_create_file.restype = ctypes.c_void_p
+    lib.cml_loader_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_f32p), ctypes.POINTER(_i32p),
+    ]
+    lib.cml_loader_acquire.restype = ctypes.c_int
+    lib.cml_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.cml_loader_produced.argtypes = [ctypes.c_void_p]
+    lib.cml_loader_produced.restype = ctypes.c_uint64
+    lib.cml_loader_destroy.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -182,14 +202,20 @@ class NativeLoader:
     def __init__(
         self,
         *,
-        kind: str,  # "classification" | "lm"
+        kind: str,  # "classification" | "lm" | "file_classification" | "file_lm"
         samples_per_slot: int,
         sample_floats: int,
         sample_ints: int,
-        nclasses_or_vocab: int,
+        nclasses_or_vocab: int = 1,
         noise: float = 0.0,
         prototypes: np.ndarray | None = None,
         successors: np.ndarray | None = None,
+        # file-backed kinds: loader gathers from these caller-owned tables
+        # (retained on self so the borrowed C++ pointers stay valid)
+        world: int = 1,
+        images: np.ndarray | None = None,  # (n, sample_floats) f32
+        labels: np.ndarray | None = None,  # (n,) i32
+        tokens: np.ndarray | None = None,  # (n,) i32
         depth: int = 4,
         nthreads: int = 2,
         seed: int = 0,
@@ -200,9 +226,46 @@ class NativeLoader:
         self._lib = lib
         self._shape_f = (samples_per_slot, sample_floats)
         self._shape_i = (samples_per_slot, sample_ints)
-        kinds = {"classification": 0, "lm": 1}
+        kinds = {"classification": 0, "lm": 1, "file_classification": 2, "file_lm": 3}
         if kind not in kinds:
             raise ValueError(f"unknown kind {kind!r}")
+        if kind in ("file_classification", "file_lm"):
+            data_p = label_p = tok_p = None
+            n_items = 0
+            token_bytes = 4
+            if kind == "file_classification":
+                if images is None or labels is None:
+                    raise ValueError(f"{kind} requires images= and labels=")
+                self._images = _as_f32(images).reshape(len(labels), sample_floats)
+                self._labels = np.ascontiguousarray(labels, np.int32)
+                data_p = self._images.ctypes.data_as(_f32p)
+                label_p = self._labels.ctypes.data_as(_i32p)
+                n_items = len(self._labels)
+            else:
+                if tokens is None:
+                    raise ValueError(f"{kind} requires tokens=")
+                tok = np.asarray(tokens).reshape(-1)
+                if tok.dtype == np.uint16:
+                    # pass the raw memmap through — the C++ side widens
+                    # per window, so a multi-GB corpus is never copied
+                    self._tokens = np.ascontiguousarray(tok)
+                    token_bytes = 2
+                else:
+                    self._tokens = np.ascontiguousarray(tok, np.int32)
+                tok_p = self._tokens.ctypes.data_as(ctypes.c_void_p)
+                n_items = len(self._tokens)
+            self._h = lib.cml_loader_create_file(
+                depth, nthreads, seed, kinds[kind],
+                samples_per_slot, sample_floats, sample_ints, world,
+                data_p, label_p, tok_p, n_items, token_bytes,
+            )
+            if not self._h:
+                raise RuntimeError(
+                    "cml_loader_create_file failed (check world divides "
+                    "samples_per_slot, and the table is large enough for "
+                    f"{world} workers: n_items={n_items})"
+                )
+            return
         proto_p = None
         succ_p = None
         if prototypes is not None:

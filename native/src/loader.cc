@@ -21,6 +21,18 @@
 //       + noise * N(0,1)   (prototype table supplied by Python)
 //   1 = Markov LM: token chain over a [vocab, 4] successor table; emitted
 //       states are in [0, vocab-1) so vocab-1 can serve as [MASK].
+//   2 = file classification: sample idx ~ U(worker shard) of a caller-
+//       owned (n_items, sample_floats) image table + (n_items,) labels;
+//       worker shards are contiguous n_items/world blocks (same layout
+//       as data.files.FileClassification.worker_shard). Pointers are
+//       BORROWED — the caller keeps the arrays alive.
+//   3 = file LM: sample_ints-token windows from a caller-owned flat
+//       (n_items,) token stream, each worker drawing starts from its
+//       contiguous n_items/world region (data.files.TokenFileDataset).
+//
+// Kinds 2/3 move the gather/copy work of file-backed datasets onto the
+// producer threads, so --data-dir training overlaps host batch assembly
+// with device compute exactly like the procedural kinds.
 
 #include <atomic>
 #include <condition_variable>
@@ -48,7 +60,10 @@ class Loader {
   Loader(int depth, int nthreads, uint64_t seed, int kind,
          int64_t samples_per_slot, int64_t sample_floats, int64_t sample_ints,
          int32_t nclasses_or_vocab, float noise, const float* prototypes,
-         const int32_t* successors)
+         const int32_t* successors, int32_t world = 1,
+         const float* file_data = nullptr, const int32_t* file_labels = nullptr,
+         const int32_t* file_tokens = nullptr, int64_t n_items = 0,
+         int32_t token_bytes = 4)
       : depth_(depth),
         seed_(seed),
         kind_(kind),
@@ -56,7 +71,13 @@ class Loader {
         sample_floats_(sample_floats),
         sample_ints_(sample_ints),
         nclasses_(nclasses_or_vocab),
-        noise_(noise) {
+        noise_(noise),
+        world_(world),
+        file_data_(file_data),
+        file_labels_(file_labels),
+        file_tokens_(file_tokens),
+        n_items_(n_items),
+        token_bytes_(token_bytes) {
     if (prototypes != nullptr && kind == 0) {
       prototypes_.assign(prototypes,
                          prototypes + (int64_t)nclasses_ * sample_floats_);
@@ -148,6 +169,39 @@ class Loader {
     for (int64_t i = 0; i < samples_per_slot_; ++i) {
       const uint64_t gid = seq * (uint64_t)samples_per_slot_ + (uint64_t)i;
       Rng rng(splitmix64(seed_ ^ (gid * 0x9E3779B97F4A7C15ULL + 0x5DEECE66DULL)));
+      if (kind_ == 2 || kind_ == 3) {
+        // worker of this sample: contiguous per-worker sample blocks
+        const int64_t per_slot = samples_per_slot_ / world_;
+        const int64_t w = (per_slot > 0) ? (i / per_slot) : 0;
+        if (kind_ == 2) {
+          const int64_t shard = n_items_ / world_;
+          const int64_t idx =
+              w * shard + (int64_t)rng.randint64((uint64_t)shard);
+          std::memcpy(slot.floats.data() + i * sample_floats_,
+                      file_data_ + idx * sample_floats_,
+                      sizeof(float) * sample_floats_);
+          for (int64_t j = 0; j < sample_ints_; ++j) {
+            slot.ints[i * sample_ints_ + j] = file_labels_[idx];
+          }
+        } else {
+          const int64_t region = n_items_ / world_;
+          const int64_t span = region - sample_ints_;  // validated at create
+          const int64_t start =
+              w * region + (int64_t)rng.randint64((uint64_t)span);
+          int32_t* dst = slot.ints.data() + i * sample_ints_;
+          if (token_bytes_ == 2) {
+            // widen uint16 ids on the fly: lets Python hand us the raw
+            // memmap without materializing an int32 copy of the corpus
+            const uint16_t* src =
+                reinterpret_cast<const uint16_t*>(file_tokens_) + start;
+            for (int64_t t = 0; t < sample_ints_; ++t) dst[t] = (int32_t)src[t];
+          } else {
+            std::memcpy(dst, file_tokens_ + start,
+                        sizeof(int32_t) * sample_ints_);
+          }
+        }
+        continue;
+      }
       if (kind_ == 0) {
         const int32_t label = (int32_t)rng.randint((uint32_t)nclasses_);
         float* img = slot.floats.data() + i * sample_floats_;
@@ -179,6 +233,12 @@ class Loader {
   const int64_t sample_ints_;
   const int32_t nclasses_;
   const float noise_;
+  const int32_t world_;
+  const float* file_data_;      // borrowed (kind 2)
+  const int32_t* file_labels_;  // borrowed (kind 2)
+  const int32_t* file_tokens_;  // borrowed (kind 3; raw uint16 when token_bytes_==2)
+  const int64_t n_items_;
+  const int32_t token_bytes_;  // 2 (uint16 memmap passthrough) or 4 (int32)
   std::vector<float> prototypes_;
   std::vector<int32_t> successors_;
 
@@ -208,6 +268,33 @@ void* cml_loader_create(int depth, int nthreads, uint64_t seed, int kind,
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, nclasses_or_vocab, noise,
                          prototypes, successors);
+}
+
+// File-backed kinds (2 = classification table, 3 = token windows). The
+// data/labels/tokens buffers are BORROWED for the loader's lifetime.
+void* cml_loader_create_file(int depth, int nthreads, uint64_t seed, int kind,
+                             int64_t samples_per_slot, int64_t sample_floats,
+                             int64_t sample_ints, int32_t world,
+                             const float* data, const int32_t* labels,
+                             const int32_t* tokens, int64_t n_items,
+                             int32_t token_bytes) {
+  if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
+  if (world < 1 || samples_per_slot % world != 0) return nullptr;
+  if (n_items < world) return nullptr;
+  if (token_bytes != 2 && token_bytes != 4) return nullptr;
+  if (kind == 2) {
+    if (data == nullptr || labels == nullptr || sample_floats < 1) return nullptr;
+    if (n_items / world < 1) return nullptr;
+  } else if (kind == 3) {
+    if (tokens == nullptr || sample_ints < 1) return nullptr;
+    if (n_items / world <= sample_ints) return nullptr;  // span must be > 0
+  } else {
+    return nullptr;
+  }
+  return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
+                         sample_floats, sample_ints, /*nclasses=*/1,
+                         /*noise=*/0.0f, nullptr, nullptr, world, data, labels,
+                         tokens, n_items, token_bytes);
 }
 
 int cml_loader_acquire(void* h, float** fptr, int32_t** iptr) {

@@ -43,6 +43,11 @@ struct Rng {
 
   inline uint32_t randint(uint32_t n) { return (uint32_t)(next() % n); }
 
+  // full-width variant: spans over 2^32 (huge per-worker token regions)
+  // must not truncate — (uint32_t)span would silently bias coverage or,
+  // on exact wrap to 0, divide by zero
+  inline uint64_t randint64(uint64_t n) { return next() % n; }
+
   // standard normal via Box-Muller (cosine branch)
   inline float gauss() {
     float u1 = uniform();

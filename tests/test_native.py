@@ -204,3 +204,116 @@ def test_training_step_on_native_pipeline():
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def _tiny_file_cls(n=64, hw=6):
+    rng = np.random.default_rng(5)
+    from consensusml_tpu.data.files import FileClassification
+
+    images = rng.normal(size=(n, hw, hw, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    return FileClassification(
+        images=images, labels=labels,
+        holdout_images=images[:4], holdout_labels=labels[:4],
+    )
+
+
+def test_native_file_round_batches_gathers_from_shards():
+    from consensusml_tpu.data import native_file_round_batches
+
+    data = _tiny_file_cls()
+    world, h, batch = 4, 2, 3
+    got = list(native_file_round_batches(data, world, h, batch, rounds=2, seed=1))
+    assert got[0]["image"].shape == (world, h, batch, 6, 6, 1)
+    # every emitted sample must be an exact row of the worker's OWN shard
+    for w in range(world):
+        xs, ys = data.worker_shard(w, world)
+        imgs = np.asarray(got[0]["image"][w]).reshape(-1, 36)
+        labs = np.asarray(got[0]["label"][w]).reshape(-1)
+        table = xs.reshape(len(xs), 36)
+        for img, lab in zip(imgs, labs):
+            hits = np.where((table == img).all(axis=1))[0]
+            assert hits.size >= 1
+            assert ys[hits[0]] == lab
+
+
+def test_native_file_round_batches_deterministic():
+    from consensusml_tpu.data import native_file_round_batches
+
+    data = _tiny_file_cls()
+    a = list(native_file_round_batches(data, 2, 1, 4, rounds=3, seed=7, nthreads=1))
+    b = list(native_file_round_batches(data, 2, 1, 4, rounds=3, seed=7, nthreads=4))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x["image"]), np.asarray(y["image"]))
+        np.testing.assert_array_equal(np.asarray(x["label"]), np.asarray(y["label"]))
+
+
+def test_native_file_token_batches_windows():
+    from consensusml_tpu.data.files import TokenFileDataset
+    from consensusml_tpu.data import native_file_token_batches
+
+    toks = (np.arange(2048, dtype=np.int32) * 3) % 251
+    data = TokenFileDataset(tokens=toks, seq_len=8, vocab_size=256,
+                            val_tokens=toks[:64])
+    world = 4
+    got = list(native_file_token_batches(data, world, 1, 4, rounds=2, seed=3))
+    ids = np.asarray(got[0]["input_ids"])
+    assert ids.shape == (world, 1, 4, 8)
+    # every window is a contiguous run from the worker's own region
+    for w in range(world):
+        lo, hi = data.worker_region(w, world)
+        for row in ids[w].reshape(-1, 8):
+            starts = np.where(toks[lo:hi] == row[0])[0]
+            assert any(
+                np.array_equal(row, toks[lo + s : lo + s + 8]) for s in starts
+            ), (w, row)
+
+
+def test_native_file_token_batches_mlm_and_determinism():
+    from consensusml_tpu.data.files import TokenFileDataset
+    from consensusml_tpu.data import native_file_token_batches
+
+    toks = np.full(1024, 3, np.int32)
+    data = TokenFileDataset(tokens=toks, seq_len=8, vocab_size=16,
+                            val_tokens=toks[:16])
+    a = list(native_file_token_batches(data, 2, 1, 2, rounds=2, seed=9,
+                                       mlm_rate=0.5, nthreads=1))
+    b = list(native_file_token_batches(data, 2, 1, 2, rounds=2, seed=9,
+                                       mlm_rate=0.5, nthreads=3))
+    for x, y in zip(a, b):
+        for key in ("input_ids", "labels", "mlm_mask"):
+            np.testing.assert_array_equal(np.asarray(x[key]), np.asarray(y[key]))
+    masked = np.asarray(a[0]["mlm_mask"]) > 0
+    assert (np.asarray(a[0]["input_ids"])[masked] == data.mask_token).all()
+
+
+def test_native_loader_rejects_too_small_token_table():
+    from consensusml_tpu.native import NativeLoader
+
+    with pytest.raises(RuntimeError, match="create_file failed"):
+        NativeLoader(
+            kind="file_lm", samples_per_slot=4, sample_floats=0,
+            sample_ints=16, world=4, tokens=np.zeros(64, np.int32),
+        )
+
+
+def test_native_file_token_batches_uint16_memmap(tmp_path):
+    """uint16 token files flow through uncopied; ids match the int32 path."""
+    from consensusml_tpu.data.files import TokenFileDataset
+    from consensusml_tpu.data import native_file_token_batches
+
+    raw = ((np.arange(1024) * 5) % 60000).astype(np.uint16)
+    p = tmp_path / "t.bin"
+    raw.tofile(p)
+    mm = np.memmap(p, dtype=np.uint16, mode="r")
+    d16 = TokenFileDataset(tokens=mm, seq_len=8, vocab_size=1 << 16,
+                           val_tokens=mm[:16])
+    d32 = TokenFileDataset(tokens=raw.astype(np.int32), seq_len=8,
+                           vocab_size=1 << 16, val_tokens=raw[:16].astype(np.int32))
+    a = list(native_file_token_batches(d16, 2, 1, 3, rounds=2, seed=11))
+    b = list(native_file_token_batches(d32, 2, 1, 3, rounds=2, seed=11))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(
+            np.asarray(x["input_ids"]), np.asarray(y["input_ids"])
+        )
+    assert np.asarray(a[0]["input_ids"]).dtype == np.int32
