@@ -57,6 +57,8 @@ def dot_product_attention(
         )
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal, bias=bias, dtype=dtype)
+    if impl != "dense":
+        raise ValueError(f"unknown attention impl {impl!r} (auto|dense|blockwise)")
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     logits = jnp.einsum(
@@ -146,8 +148,10 @@ def blockwise_attention(
         correction = jnp.exp(row_max - new_max)
         probs = jnp.exp(logits - new_max[..., None])
         new_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+        # same MXU recipe as the dense path: inputs in compute dtype,
+        # accumulate f32 (a full-f32 matmul would halve MXU throughput)
         blk_out = jnp.einsum(
-            "bhst,bthd->bshd", probs, jnp.asarray(v_t, jnp.float32),
+            "bhst,bthd->bshd", probs.astype(v_t.dtype), v_t,
             preferred_element_type=jnp.float32,
         )
         new_out = out * correction.transpose(0, 2, 1)[..., None] + blk_out
@@ -159,8 +163,13 @@ def blockwise_attention(
         jnp.zeros((b, h, s), jnp.float32),
         jnp.asarray(0, jnp.int32),
     )
+    # remat the block step: without it, grad-of-scan stores every block's
+    # probs residuals — O(S*T) again, exactly what this path exists to
+    # avoid. Recomputing a block's softmax in the backward trades a few
+    # flops for the flash-attention memory bound.
     (out, _, row_sum, _), _ = jax.lax.scan(
-        step, carry0, (kb, vb, bb) if bb is not None else (kb, vb, None)
+        jax.checkpoint(step), carry0,
+        (kb, vb, bb) if bb is not None else (kb, vb, None),
     )
     denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
     return (out / denom).astype(dtype)

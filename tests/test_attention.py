@@ -67,29 +67,55 @@ def test_auto_dispatch_threshold():
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(blk))
 
 
-def test_blockwise_memory_vs_dense():
-    """The point of the exercise: dense peak temp memory carries the full
-    (B, H, S, S) f32 score matrix; blockwise must not."""
-    b, s, h, d = 1, 2048, 4, 16
-    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
-    dense_c = (
-        jax.jit(lambda q: dot_product_attention(q, q, q, causal=True, impl="dense"))
-        .lower(q).compile()
-    )
-    blk_c = (
-        jax.jit(lambda q: dot_product_attention(q, q, q, causal=True, impl="blockwise"))
-        .lower(q).compile()
-    )
+def _temp_bytes(fn, *args):
+    c = jax.jit(fn).lower(*args).compile()
     try:
-        dense_tmp = dense_c.memory_analysis().temp_size_in_bytes
-        blk_tmp = blk_c.memory_analysis().temp_size_in_bytes
+        return c.memory_analysis().temp_size_in_bytes
     except (AttributeError, NotImplementedError):
         pytest.skip("memory_analysis unsupported on this backend")
+
+
+def test_blockwise_memory_vs_dense_forward():
+    """Dense forward peak temp memory carries the full (B, H, S, S) f32
+    score matrix; blockwise must not."""
+    b, s, h, d = 1, 2048, 4, 16
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    dense_tmp = _temp_bytes(
+        lambda q: dot_product_attention(q, q, q, causal=True, impl="dense"), q
+    )
+    blk_tmp = _temp_bytes(
+        lambda q: dot_product_attention(q, q, q, causal=True, impl="blockwise"), q
+    )
     score_bytes = b * h * s * s * 4
     assert dense_tmp >= score_bytes  # sanity: dense really pays S^2
     # blockwise must beat the score matrix and stay well under dense peak
     # (measured here: ~35 MB vs dense ~136 MB at S=2048)
     assert blk_tmp < score_bytes, (dense_tmp, blk_tmp)
+    assert blk_tmp < dense_tmp / 2, (dense_tmp, blk_tmp)
+
+
+def test_blockwise_memory_vs_dense_backward():
+    """The TRAINING memory bound is what matters: without remat on the
+    scan step, grad-of-blockwise stores per-block probs residuals summing
+    to the same O(S*T) the dense path pays."""
+    b, s, h, d = 1, 2048, 4, 16
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+
+    def loss(impl):
+        def f(q):
+            return jnp.sum(
+                jnp.asarray(
+                    dot_product_attention(q, q, q, causal=True, impl=impl),
+                    jnp.float32,
+                )
+            )
+
+        return f
+
+    dense_tmp = _temp_bytes(jax.grad(loss("dense")), q)
+    blk_tmp = _temp_bytes(jax.grad(loss("blockwise")), q)
+    score_bytes = b * h * s * s * 4
+    assert dense_tmp >= score_bytes
     assert blk_tmp < dense_tmp / 2, (dense_tmp, blk_tmp)
 
 
