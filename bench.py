@@ -206,7 +206,9 @@ def _consensus_bench() -> dict:
 def main() -> None:
     if "--_inner" in sys.argv:
         batch = int(os.environ.get("BENCH_BATCH", "128"))
-        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        # 30 steps per dispatch: the tunneled backend's one-time
+        # dispatch+fetch round-trip amortizes to <2ms/step (docs/perf.md)
+        steps = int(os.environ.get("BENCH_STEPS", "30"))
         image = int(os.environ.get("BENCH_IMAGE", "224"))
         print("INNER_RESULT " + json.dumps(_inner(batch, steps, image)), flush=True)
         return

@@ -23,7 +23,11 @@ Two interchangeable backends share the mixing operator defined by a
   against.
 """
 
-from consensusml_tpu.comm.mesh import WorkerMesh, local_device_mesh  # noqa: F401
+from consensusml_tpu.comm.mesh import (  # noqa: F401
+    WorkerMesh,
+    local_device_mesh,
+    slice_major_devices,
+)
 from consensusml_tpu.comm.collectives import (  # noqa: F401
     consensus_error,
     mix,

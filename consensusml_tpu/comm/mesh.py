@@ -18,7 +18,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from consensusml_tpu.topology import Topology
 
-__all__ = ["WorkerMesh", "local_device_mesh"]
+__all__ = ["WorkerMesh", "local_device_mesh", "slice_major_devices"]
+
+
+def slice_major_devices(devices: Sequence[jax.Device] | None = None) -> list[jax.Device]:
+    """Order devices slice-major: all of slice 0, then slice 1, ...
+
+    For :class:`~consensusml_tpu.topology.HierarchicalTopology` this is
+    the layout that makes the topology's axis 0 ("slices") cross slice
+    boundaries — its 1-in-K outer-ring ppermutes ride DCN while the
+    per-round inner-ring ppermutes stay on ICI. The sort is stable and
+    keys ONLY on ``slice_index``, so devices without one (CPU,
+    single-slice pods) keep their original order — safe to call
+    unconditionally.
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    return sorted(devices, key=lambda d: getattr(d, "slice_index", 0) or 0)
 
 
 def local_device_mesh(n: int, platform: str | None = None) -> list[jax.Device]:

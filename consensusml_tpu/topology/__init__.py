@@ -15,6 +15,7 @@ empty, see SURVEY.md). Here a topology is pure math: it yields
 from consensusml_tpu.topology.topologies import (  # noqa: F401
     DenseTopology,
     ExponentialTopology,
+    HierarchicalTopology,
     OnePeerExponentialTopology,
     RingTopology,
     Shift,

@@ -36,6 +36,7 @@ __all__ = [
     "ExponentialTopology",
     "TimeVaryingTopology",
     "OnePeerExponentialTopology",
+    "HierarchicalTopology",
     "topology_from_name",
 ]
 
@@ -387,10 +388,69 @@ class OnePeerExponentialTopology(TimeVaryingTopology):
         super().__init__(phases, name="onepeer-exp")
 
 
+class HierarchicalTopology(TimeVaryingTopology):
+    """Ring-of-rings for multi-slice pods: inner gossip on ICI every
+    round, inter-slice gossip on DCN every ``outer_every``-th round.
+
+    The mesh is ``(slices, inner)``. Phases ``0 .. outer_every-2`` mix
+    along the INNER ring only — ppermutes between chips of one slice,
+    riding ICI. Phase ``outer_every-1`` mixes along the OUTER ring —
+    ppermutes between corresponding chips of neighboring slices, riding
+    the (order-of-magnitude slower) DCN links, amortized 1-in-K. Every
+    phase is doubly stochastic, so the time-varying engine's existing
+    collective/simulated paths, fault masking rules and per-period
+    spectral gap apply unchanged.
+
+    This is the TPU answer to SURVEY.md §5's "DCN for multi-slice if ever
+    needed": lay the outer axis across slice boundaries (see
+    ``comm.mesh.slice_major_devices``) and the ppermute placement does
+    the rest — no NCCL-style hierarchical communicator tree needed.
+    """
+
+    def __init__(
+        self,
+        slices: int,
+        inner: int,
+        outer_every: int = 4,
+        axis_names: tuple[str, str] = ("slices", "workers"),
+    ):
+        if slices < 1 or inner < 1:
+            raise ValueError(f"need positive dims, got {slices}x{inner}")
+        if outer_every < 1:
+            raise ValueError(f"outer_every must be >= 1, got {outer_every}")
+        if outer_every < 2 and inner > 1:
+            # zero inner phases would leave workers within a slice
+            # disconnected: the graph never reaches consensus
+            raise ValueError(
+                f"outer_every=1 with inner={inner} > 1 has no inner-ring "
+                "phase, so workers inside a slice never mix; use "
+                "outer_every >= 2 (or inner=1)"
+            )
+        mesh = (slices, inner)
+
+        def ring_phase(axis: int, size: int, tag: str) -> Topology:
+            shifts, self_w = _metropolis_ring(size)
+            shifts = tuple(Shift(axis, s.offset, s.weight) for s in shifts)
+            return Topology(
+                mesh_shape=mesh,
+                axis_names=axis_names,
+                shifts=shifts,
+                self_weight=self_w,
+                name=f"hier-{tag}",
+            )
+
+        inner_phase = ring_phase(1, inner, "inner")
+        outer_phase = ring_phase(0, slices, "outer")
+        phases = [inner_phase] * (outer_every - 1) + [outer_phase]
+        super().__init__(phases, name="hierarchical")
+
+
 def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:
     """Build a topology from a CLI-style name:
     ring | torus | dense | exp (static exponential graph) |
-    onepeer-exp (time-varying one-peer exponential).
+    onepeer-exp (time-varying one-peer exponential) |
+    hierarchical (multi-slice ring-of-rings; pass ``slices=`` and
+    optionally ``outer_every=``).
 
     For ``torus``, pass ``rows``/``cols`` or let it factor ``world_size``
     into the squarest grid."""
@@ -429,6 +489,23 @@ def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:
         if rows * cols != world_size:
             raise ValueError(f"torus {rows}x{cols} != world_size {world_size}")
         return TorusTopology(rows, cols)
+    if name in ("hierarchical", "hier", "ring-of-rings"):
+        if unknown := set(kwargs) - {"slices", "outer_every"}:
+            raise ValueError(f"hierarchical topology got unknown args {sorted(unknown)}")
+        slices = kwargs.get("slices")
+        if slices is None:
+            raise ValueError("hierarchical topology needs slices=<int>")
+        if slices < 1:
+            raise ValueError(f"slices must be positive, got {slices}")
+        if world_size % slices:
+            raise ValueError(
+                f"slices={slices} does not divide world_size={world_size}"
+            )
+        return HierarchicalTopology(
+            slices, world_size // slices,
+            outer_every=kwargs.get("outer_every", 4),
+        )
     raise ValueError(
-        f"unknown topology {name!r} (expected ring|torus|dense|exp|onepeer-exp)"
+        f"unknown topology {name!r} "
+        "(expected ring|torus|dense|exp|onepeer-exp|hierarchical)"
     )

@@ -56,6 +56,8 @@ _SLOW_TESTS = {
     "test_train_model_axes_bad_syntax",
     "test_train_model_axes_multi_axis_rejected",
     "test_train_model_axes_zero_rejected",
+    "test_train_topology_override_hierarchical",
+    "test_train_topology_override_bad_name",
     # time-varying topology convergence
     "test_onepeer_beats_ring_consensus_decay",
     "test_choco_collective_matches_simulated_onepeer",

@@ -128,3 +128,22 @@ def test_train_model_axes_zero_rejected():
     )
     assert r.returncode == 2
     assert "sizes must be" in r.stderr
+
+
+def test_train_topology_override_hierarchical():
+    r = _run(
+        ["train.py", "--config", "cifar_resnet50", "--device", "cpu",
+         "--rounds", "2", "--topology", "hierarchical:slices=2,outer_every=2"],
+        timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "final:" in r.stdout
+
+
+def test_train_topology_override_bad_name():
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "1", "--topology", "bogus"],
+    )
+    assert r.returncode == 2
+    assert "bad --topology" in r.stderr

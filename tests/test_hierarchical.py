@@ -1,0 +1,141 @@
+"""Multi-slice hierarchical (ring-of-rings) gossip topology.
+
+SURVEY.md §5 "DCN for multi-slice if ever needed": inner-ring phases ride
+ICI every round, the inter-slice outer ring fires 1-in-outer_every
+rounds. These tests pin the math (doubly-stochastic phases, per-period
+contraction, wire-cost ratio) and backend agreement on a 2x4 virtual
+mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.comm import WorkerMesh, slice_major_devices
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.data import SyntheticClassification, round_batches
+from consensusml_tpu.models import MLP, mlp_loss_fn
+from consensusml_tpu.topology import HierarchicalTopology, topology_from_name
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    init_stacked_state,
+    make_collective_train_step,
+    make_simulated_train_step,
+)
+
+
+def test_phases_structure_and_double_stochasticity():
+    topo = HierarchicalTopology(slices=2, inner=4, outer_every=3)
+    assert topo.period == 3
+    assert topo.mesh_shape == (2, 4)
+    # phases 0..K-2 move along the inner axis only, phase K-1 outer only
+    for p in topo.phases[:-1]:
+        assert {s.axis for s in p.shifts} == {1}
+    assert {s.axis for s in topo.phases[-1].shifts} == {0}
+    for w in topo.phase_matrices():
+        np.testing.assert_allclose(w.sum(0), 1.0, atol=1e-12)
+        np.testing.assert_allclose(w.sum(1), 1.0, atol=1e-12)
+        assert (w >= 0).all()
+
+
+def test_period_contracts_to_consensus():
+    topo = HierarchicalTopology(slices=2, inner=4, outer_every=4)
+    gap = topo.spectral_gap()
+    assert 0 < gap <= 1
+    # inner-only phases never mix across slices: a slice-wise-constant
+    # disagreement survives until the outer phase fires
+    w_inner = topo.phase_matrices()[0]
+    x = np.kron(np.array([1.0, -1.0]), np.ones(4))  # +1 on slice0, -1 on slice1
+    np.testing.assert_allclose(w_inner @ x, x, atol=1e-12)
+    w_eff = topo.effective_matrix()
+    assert np.linalg.norm(w_eff @ x - x.mean()) < np.linalg.norm(x)
+
+
+def test_outer_round_wire_cost_is_amortized():
+    """The design point: only 1 round in outer_every touches the slow
+    inter-slice axis."""
+    topo = HierarchicalTopology(slices=4, inner=8, outer_every=5)
+    outer_rounds = sum(
+        1 for p in topo.phases if any(s.axis == 0 for s in p.shifts)
+    )
+    assert outer_rounds == 1 and topo.period == 5
+
+
+def test_from_name_and_validation():
+    topo = topology_from_name("hierarchical", 8, slices=2, outer_every=2)
+    assert isinstance(topo, HierarchicalTopology)
+    assert topo.mesh_shape == (2, 4)
+    with pytest.raises(ValueError, match="slices"):
+        topology_from_name("hierarchical", 8)
+    with pytest.raises(ValueError, match="divide"):
+        topology_from_name("hierarchical", 8, slices=3)
+
+
+def test_slice_major_devices_is_safe_without_slices():
+    devs = slice_major_devices()
+    assert len(devs) == len(jax.devices())
+    assert [d.id for d in devs] == sorted(d.id for d in devs)
+
+
+def test_collective_matches_simulated_hierarchical():
+    topo = HierarchicalTopology(slices=2, inner=4, outer_every=2)
+    model = MLP(hidden=16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.adam(1e-2), h=1
+    )
+    init = lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+    loss_fn = mlp_loss_fn(model)
+    data = SyntheticClassification(n=512)
+
+    sim_step = make_simulated_train_step(cfg, loss_fn)
+    wmesh = WorkerMesh.create(topo, devices=slice_major_devices()[:8])
+    col_step = make_collective_train_step(cfg, loss_fn, wmesh)
+
+    state = init_stacked_state(cfg, init, jax.random.key(3), 8)
+    sim_state, col_state = state, wmesh.shard_stacked(state)
+    for batch in round_batches(data, 8, h=1, batch=8, rounds=4):
+        sim_state, sm = sim_step(sim_state, batch)
+        col_state, cm = col_step(col_state, batch)
+        np.testing.assert_allclose(
+            float(sm["consensus_error"]), float(cm["consensus_error"]), rtol=1e-4
+        )
+    for a, b in zip(jax.tree.leaves(sim_state.params), jax.tree.leaves(col_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_hierarchical_training_converges():
+    topo = HierarchicalTopology(slices=2, inner=2, outer_every=3)
+    model = MLP(hidden=16)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=topo), optimizer=optax.adam(5e-3), h=1
+    )
+    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state = init_stacked_state(
+        cfg,
+        lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"],
+        jax.random.key(0),
+        4,
+    )
+    data = SyntheticClassification(n=512)
+    losses, errs = [], []
+    for batch in round_batches(data, 4, h=1, batch=16, rounds=30):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+        errs.append(float(m["consensus_error"]))
+    assert losses[-1] < losses[0] * 0.7
+    assert errs[-1] < errs[2]
+
+
+def test_outer_every_one_rejected_when_inner_mixing_needed():
+    with pytest.raises(ValueError, match="never mix"):
+        HierarchicalTopology(slices=2, inner=4, outer_every=1)
+    # inner=1 has nothing to mix inside a slice: outer-only is fine
+    topo = HierarchicalTopology(slices=4, inner=1, outer_every=1)
+    assert topo.period == 1
+
+
+def test_from_name_rejects_nonpositive_slices():
+    with pytest.raises(ValueError, match="positive"):
+        topology_from_name("hierarchical", 8, slices=0)

@@ -46,6 +46,12 @@ def parse_args(argv=None):
     p.add_argument("--slowmo-beta", type=float, default=None,
                    help="enable the SlowMo outer optimizer with this slow-momentum "
                         "decay (e.g. 0.8); default off")
+    p.add_argument("--topology", default=None,
+                   help='override the config\'s gossip graph: "ring", "torus", '
+                        '"dense", "exp", "onepeer-exp", or with args e.g. '
+                        '"hierarchical:slices=2,outer_every=4" (multi-slice '
+                        'ring-of-rings — inner ring on ICI every round, '
+                        'inter-slice ring on DCN 1-in-K rounds)')
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
@@ -109,6 +115,25 @@ def main(argv=None) -> int:
     platform = jax.default_backend()
     scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
     bundle = configs.build(args.config, scale, data_dir=args.data_dir)
+
+    if args.topology is not None:
+        import dataclasses
+
+        from consensusml_tpu.topology import topology_from_name
+
+        name, _, argstr = args.topology.partition(":")
+        try:
+            topo_kwargs = dict(
+                (kv.split("=")[0].strip(), int(kv.split("=")[1]))
+                for kv in argstr.split(",") if kv
+            )
+            topo = topology_from_name(name, bundle.world_size, **topo_kwargs)
+        except (IndexError, ValueError) as e:
+            print(f"error: bad --topology {args.topology!r}: {e}", file=sys.stderr)
+            return 2
+        bundle.cfg = dataclasses.replace(
+            bundle.cfg, gossip=dataclasses.replace(bundle.cfg.gossip, topology=topo)
+        )
 
     if args.drop_prob > 0 or args.push_sum:
         import dataclasses
@@ -230,8 +255,14 @@ def main(argv=None) -> int:
         bundle.cfg, bundle.init_params, jax.random.key(args.seed), bundle.world_size
     )
     if backend == "collective":
+        from consensusml_tpu.comm import slice_major_devices
+
+        # slice-major order puts a hierarchical topology's outer axis
+        # across slice boundaries (DCN) and keeps inner rings on ICI; on
+        # single-slice/CPU hosts the stable sort leaves order unchanged
+        devices = slice_major_devices()[: bundle.world_size * per_worker]
         wmesh = WorkerMesh.create(
-            bundle.cfg.gossip.topology, model_axes=model_axes
+            bundle.cfg.gossip.topology, devices=devices, model_axes=model_axes
         )
         step = make_collective_train_step(bundle.cfg, bundle.loss_fn, wmesh)
         rules = (
