@@ -1,9 +1,11 @@
 """Runtime utilities: checkpointing, metrics logging, tracing."""
 
 from consensusml_tpu.utils.checkpoint import (  # noqa: F401
+    checkpoint_world_size,
     restore_state,
     save_state,
 )
+from consensusml_tpu.utils.elastic import resize_state  # noqa: F401
 from consensusml_tpu.utils.logging import MetricsLogger  # noqa: F401
 from consensusml_tpu.utils.profiling import (  # noqa: F401
     RoundStats,

@@ -9,23 +9,51 @@ because workers are mesh shards, not processes.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
-__all__ = ["save_state", "restore_state"]
+__all__ = ["save_state", "restore_state", "checkpoint_world_size"]
 
 
 def save_state(path: str, state: Any, step: int | None = None) -> str:
-    """Write a checkpoint at ``path`` (optionally ``path/step_N``)."""
+    """Write a checkpoint at ``path`` (optionally ``path/step_N``).
+
+    Alongside the orbax tree a small ``cml_meta.json`` records the world
+    size (leading axis of ``state.step`` when present), which lets
+    elastic resume (``utils.elastic``) rebuild the right-sized restore
+    template without the caller knowing the original worker count.
+    """
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, state, force=True)
+    step_leaf = getattr(state, "step", None)
+    if step_leaf is not None and getattr(step_leaf, "ndim", 0) == 1:
+        # atomic write: a preemption mid-write must leave either no meta
+        # (falls back to pre-meta behavior) or a complete one — never a
+        # truncated file that poisons every later --resume
+        meta = os.path.join(path, "cml_meta.json")
+        tmp = meta + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"world_size": int(step_leaf.shape[0])}, f)
+        os.replace(tmp, meta)
     return path
+
+
+def checkpoint_world_size(path: str) -> int | None:
+    """World size recorded at save time, or None (pre-meta checkpoint or
+    unreadable/corrupt meta — treated as absent, never raised)."""
+    meta = os.path.join(os.path.abspath(path), "cml_meta.json")
+    try:
+        with open(meta) as f:
+            return int(json.load(f)["world_size"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
 
 
 def restore_state(path: str, like: Any) -> Any:

@@ -1,0 +1,128 @@
+"""Elastic world membership: resume a run at a different worker count.
+
+SURVEY.md §5 flags elastic recovery as plausible in the reference (mount
+empty). The TPU design makes *in-flight* membership change meaningless —
+workers are mesh shards inside one compiled program, not processes that
+can come and go — so elasticity lives at the CHECKPOINT boundary instead:
+``train.py --resume ckpt --workers W'`` restores a checkpoint written at
+any world size and resizes the stacked state to the new mesh.
+
+Semantics (:func:`resize_state`):
+
+- **shrink** (W -> W' < W): replicas ``0..W'-1`` keep their exact state
+  (params, optimizer, rng). Departed workers' replicas are dropped — the
+  same information loss a real leave event causes; the survivors'
+  consensus process is unaffected because every mixing matrix row is a
+  convex combination.
+- **grow** (W -> W' > W): joiners bootstrap from the CONSENSUS MEAN of
+  the existing replicas (what a real joiner would fetch from the network)
+  with a FRESH optimizer state and a fresh rng stream (folded from the
+  caller's key), then drift apart naturally through local SGD.
+- **gossip state is reset for everyone**: CHOCO's ``xhat``/``s`` tracking
+  and push-sum's mass conservation are invariants over a FIXED membership
+  — stale tracking from a different world would silently bias the mean.
+  One reset means compressed gossip re-warms its error-feedback (a few
+  rounds of extra consensus error, visible in the metric), which is the
+  honest cost of a membership change.
+- **SlowMo restarts** (``x = params, u = 0``): slow momentum from a
+  different membership is not meaningful.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.train.local_sgd import LocalSGDConfig, TrainState, _gossiped
+from consensusml_tpu.train.outer import slowmo_init
+
+__all__ = ["resize_state"]
+
+
+def _consensus_mean(tree: Any) -> Any:
+    """Worker-mean of stacked leaves, reduced in f32, cast back."""
+    return jax.tree.map(
+        lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(x.dtype),
+        tree,
+    )
+
+
+def _take(tree: Any, n: int) -> Any:
+    return jax.tree.map(lambda x: x[:n], tree)
+
+
+def _grow(tree: Any, mean_tree: Any, n_new: int) -> Any:
+    return jax.tree.map(
+        lambda x, m: jnp.concatenate(
+            [x, jnp.broadcast_to(m[None], (n_new, *m.shape)).astype(x.dtype)]
+        ),
+        tree,
+        mean_tree,
+    )
+
+
+def resize_state(
+    cfg: LocalSGDConfig,
+    state: TrainState,
+    new_world: int,
+    rng: jax.Array | None = None,
+) -> TrainState:
+    """Return ``state`` resized to ``new_world`` stacked replicas.
+
+    No-op when the size already matches. ``rng`` seeds the JOINERS' data
+    streams when growing (defaults to ``jax.random.key(0)``). The result
+    is host-side/unsharded — re-shard with ``WorkerMesh.shard_stacked``
+    for the collective backend.
+    """
+    old_world = int(state.step.shape[0])
+    if new_world == old_world:
+        return state
+    if new_world < 1:
+        raise ValueError(f"new_world must be positive, got {new_world}")
+
+    if new_world < old_world:
+        params = _take(state.params, new_world)
+        model_state = _take(state.model_state, new_world)
+        opt_state = _take(state.opt_state, new_world)
+        rngs = state.rng[:new_world]
+        step = state.step[:new_world]
+    else:
+        n_new = new_world - old_world
+        mean_p = _consensus_mean(state.params)
+        mean_ms = _consensus_mean(state.model_state)
+        params = _grow(state.params, mean_p, n_new)
+        model_state = _grow(state.model_state, mean_ms, n_new)
+        # joiners: fresh optimizer state on their (mean) params
+        new_block = jax.tree.map(
+            lambda m: jnp.broadcast_to(m[None], (n_new, *m.shape)), mean_p
+        )
+        new_opt = jax.vmap(cfg.optimizer.init)(new_block)
+        opt_state = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b.astype(a.dtype)]),
+            state.opt_state,
+            new_opt,
+        )
+        base = jax.random.key(0) if rng is None else rng
+        new_rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            base, jnp.arange(old_world, new_world)
+        )
+        rngs = jnp.concatenate([state.rng, new_rngs])
+        step = jnp.concatenate(
+            [state.step, jnp.broadcast_to(state.step[:1], (n_new,))]
+        )
+
+    return TrainState(
+        step=step,
+        params=params,
+        model_state=model_state,
+        opt_state=opt_state,
+        # membership changed: tracking/mass invariants from the old world
+        # no longer hold — reset (see module docstring for the cost)
+        gossip=cfg.engine().init_state(
+            _gossiped(params, model_state), world_size=new_world
+        ),
+        rng=rngs,
+        outer=slowmo_init(params) if cfg.outer is not None else None,
+    )

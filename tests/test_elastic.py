@@ -1,0 +1,158 @@
+"""Elastic world membership via checkpoint resize (utils.elastic).
+
+SURVEY.md §5 "elastic recovery": the TPU design has no in-flight
+join/leave (workers are mesh shards of one program), so membership
+change happens at the checkpoint boundary — these tests pin the resize
+semantics and the end-to-end --resume --workers path.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.compress import topk_int8_compressor
+from consensusml_tpu.consensus import GossipConfig
+from consensusml_tpu.data import SyntheticClassification, round_batches
+from consensusml_tpu.models import MLP, mlp_loss_fn
+from consensusml_tpu.topology import RingTopology
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    init_stacked_state,
+    make_simulated_train_step,
+)
+from consensusml_tpu.utils import resize_state
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(world, compressor=None):
+    return LocalSGDConfig(
+        gossip=GossipConfig(
+            topology=RingTopology(world),
+            compressor=compressor,
+            gamma=0.5 if compressor else 1.0,
+        ),
+        optimizer=optax.adam(1e-2),
+        h=1,
+    )
+
+
+def _trained_state(world=4, rounds=3, compressor=None):
+    model = MLP(hidden=16)
+    cfg = _cfg(world, compressor)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state = init_stacked_state(
+        cfg,
+        lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
+        jax.random.key(0),
+        world,
+    )
+    data = SyntheticClassification(n=256)
+    for batch in round_batches(data, world, 1, 8, rounds):
+        state, _ = step(state, batch)
+    return model, cfg, state, data
+
+
+def test_grow_joiners_start_at_consensus_mean():
+    model, cfg, state, _ = _trained_state(world=4)
+    new_cfg = _cfg(6)
+    resized = resize_state(new_cfg, state, 6, rng=jax.random.key(7))
+    assert resized.step.shape == (6,)
+    mean = jax.tree.map(
+        lambda x: np.mean(np.asarray(x, np.float32), axis=0), state.params
+    )
+    for leaf, m in zip(jax.tree.leaves(resized.params), jax.tree.leaves(mean)):
+        np.testing.assert_allclose(np.asarray(leaf[4]), m, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(leaf[5]), m, rtol=1e-6)
+    # survivors keep their exact replicas
+    for leaf, old in zip(jax.tree.leaves(resized.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(leaf[:4]), np.asarray(old))
+    # joiners' rng streams are fresh and distinct
+    keys = np.asarray(jax.random.key_data(resized.rng))
+    assert not np.array_equal(keys[4], keys[5])
+    # step counter carries the round count to joiners
+    assert int(resized.step[5]) == int(state.step[0])
+
+
+def test_shrink_keeps_survivor_replicas_exactly():
+    model, cfg, state, _ = _trained_state(world=6)
+    new_cfg = _cfg(4)
+    resized = resize_state(new_cfg, state, 4)
+    assert resized.step.shape == (4,)
+    for leaf, old in zip(jax.tree.leaves(resized.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(old)[:4])
+    for leaf, old in zip(
+        jax.tree.leaves(resized.opt_state), jax.tree.leaves(state.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(old)[:4])
+
+
+def test_resize_resets_choco_state_at_new_world():
+    comp = topk_int8_compressor(ratio=0.5, chunk=128)
+    model, cfg, state, _ = _trained_state(world=4, compressor=comp)
+    assert state.gossip is not None
+    new_cfg = _cfg(6, compressor=comp)
+    resized = resize_state(new_cfg, state, 6, rng=jax.random.key(1))
+    for leaf in jax.tree.leaves(resized.gossip.xhat):
+        assert leaf.shape[0] == 6
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+
+def test_training_continues_after_resize_both_ways():
+    for new_world in (6, 2):
+        model, cfg, state, data = _trained_state(world=4)
+        new_cfg = _cfg(new_world)
+        resized = resize_state(new_cfg, state, new_world, rng=jax.random.key(2))
+        step = make_simulated_train_step(new_cfg, mlp_loss_fn(model))
+        losses = []
+        start = int(resized.step[0])
+        for batch in round_batches(data, new_world, 1, 8, 10, start=start):
+            resized, m = step(resized, batch)
+            losses.append(float(m["loss"]))
+            assert np.isfinite(losses[-1])
+        assert losses[-1] < losses[0] * 1.5  # trains, no blow-up
+
+
+def test_noop_resize_returns_state():
+    model, cfg, state, _ = _trained_state(world=4)
+    assert resize_state(cfg, state, 4) is state
+    with pytest.raises(ValueError, match="positive"):
+        resize_state(cfg, state, 0)
+
+
+@pytest.mark.slow
+def test_cli_elastic_resume(tmp_path):
+    """End-to-end: checkpoint at 4 workers, resume at 6."""
+    ck = str(tmp_path / "ck")
+
+    def run(extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "train.py"),
+             "--config", "mnist_mlp", "--device", "cpu", *extra],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": ""},
+        )
+
+    r1 = run(["--rounds", "3", "--checkpoint-dir", ck])
+    assert r1.returncode == 0, r1.stderr[-800:]
+    ckpt = os.path.join(ck, "step_3")
+    assert os.path.exists(os.path.join(ckpt, "cml_meta.json"))
+    ck2 = str(tmp_path / "ck6")
+    r2 = run(["--rounds", "2", "--workers", "6", "--resume", ckpt,
+              "--checkpoint-dir", ck2])
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "elastic resume: 4 -> 6 workers" in r2.stdout
+    assert "resumed from" in r2.stdout and "at round 3" in r2.stdout
+    assert "final:" in r2.stdout
+    # forgetting --workers on a non-default-world checkpoint must ADOPT
+    # the checkpoint's world, not silently shrink it back to the default
+    r3 = run(["--rounds", "1", "--resume", os.path.join(ck2, "step_5")])
+    assert r3.returncode == 0, r3.stderr[-800:]
+    assert "workers=6" in r3.stdout
+    assert "elastic resume" not in r3.stdout

@@ -46,6 +46,12 @@ def parse_args(argv=None):
     p.add_argument("--slowmo-beta", type=float, default=None,
                    help="enable the SlowMo outer optimizer with this slow-momentum "
                         "decay (e.g. 0.8); default off")
+    p.add_argument("--workers", type=int, default=None,
+                   help="override the config's worker count (topology is "
+                        "rebuilt at this size). With --resume this is the "
+                        "ELASTIC path: a checkpoint from any world size is "
+                        "resized — joiners start from the consensus mean, "
+                        "leavers' replicas are dropped (utils.elastic)")
     p.add_argument("--topology", default=None,
                    help='override the config\'s gossip graph: "ring", "torus", '
                         '"dense", "exp", "onepeer-exp", or with args e.g. '
@@ -114,7 +120,28 @@ def main(argv=None) -> int:
 
     platform = jax.default_backend()
     scale = args.scale or ("full" if platform in ("tpu", "axon") else "smoke")
-    bundle = configs.build(args.config, scale, data_dir=args.data_dir)
+    ckpt_world = None
+    if args.resume:
+        from consensusml_tpu.utils import checkpoint_world_size
+
+        ckpt_world = checkpoint_world_size(args.resume)
+        if ckpt_world is None and args.workers is not None:
+            print(
+                "warning: checkpoint has no world-size record (pre-meta "
+                "checkpoint); --workers must match its original world or "
+                "the restore will fail with a shape mismatch",
+                file=sys.stderr,
+            )
+    # without an explicit --workers, a resumed run adopts the checkpoint's
+    # world size — forgetting the flag must never silently drop replicas
+    world = args.workers if args.workers is not None else ckpt_world
+    try:
+        bundle = configs.build(
+            args.config, scale, data_dir=args.data_dir, world=world
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
     if args.topology is not None:
         import dataclasses
@@ -251,9 +278,6 @@ def main(argv=None) -> int:
         flush=True,
     )
 
-    state = init_stacked_state(
-        bundle.cfg, bundle.init_params, jax.random.key(args.seed), bundle.world_size
-    )
     if backend == "collective":
         from consensusml_tpu.comm import slice_major_devices
 
@@ -268,13 +292,54 @@ def main(argv=None) -> int:
         rules = (
             bundle.tp_rules(model_axes[0][0]) if model_axes else None
         )
-        state = wmesh.shard_stacked(state, rules=rules)
+        shard = lambda s: wmesh.shard_stacked(s, rules=rules)
     else:
         step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
+        shard = lambda s: s
 
     start = 0
+    # Elastic resume fires only on an EXPLICIT --workers override that
+    # differs from the checkpoint's recorded world; it builds the old-world
+    # template instead of (not in addition to) the new-world one.
+    elastic_from = (
+        ckpt_world
+        if args.resume
+        and args.workers is not None
+        and ckpt_world is not None
+        and ckpt_world != bundle.world_size
+        else None
+    )
+    if elastic_from is not None:
+        from consensusml_tpu.utils import resize_state
+
+        # template leaves stay jax arrays: orbax takes each leaf's
+        # sharding from the template (single-device here; the resize
+        # result is sharded onto the worker mesh by `shard`)
+        old_template = init_stacked_state(
+            bundle.cfg, bundle.init_params, jax.random.key(args.seed), elastic_from
+        )
+        restored = restore_state(args.resume, old_template)
+        state = shard(
+            resize_state(
+                bundle.cfg, restored, bundle.world_size,
+                rng=jax.random.key(args.seed + 1),
+            )
+        )
+        print(
+            f"elastic resume: {elastic_from} -> {bundle.world_size} workers "
+            "(joiners from consensus mean; gossip state reset)",
+            flush=True,
+        )
+    else:
+        state = shard(
+            init_stacked_state(
+                bundle.cfg, bundle.init_params, jax.random.key(args.seed),
+                bundle.world_size,
+            )
+        )
+        if args.resume:
+            state = restore_state(args.resume, state)
     if args.resume:
-        state = restore_state(args.resume, state)
         import numpy as np
 
         # per-worker step counters are identical; resume the data stream at
