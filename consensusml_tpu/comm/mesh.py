@@ -146,12 +146,47 @@ class WorkerMesh:
 
         return _sharding.stacked_shardings(tree, self.mesh, self.axis_names, rules)
 
-    def shard_stacked(self, tree, rules=None):
-        """device_put a flat-stacked pytree onto the mesh."""
-        import jax as _jax
+    def shard_stacked(self, tree, rules=None, shardings=None):
+        """Place a flat-stacked pytree onto the mesh.
 
-        shardings = self.stacked_shardings(tree, rules)
-        return _jax.tree.map(_jax.device_put, tree, shardings)
+        Single-process: plain ``device_put``. Multi-controller
+        (``jax.process_count() > 1``): ``device_put`` cannot target
+        non-addressable devices, so each process contributes its
+        addressable shards via ``make_array_from_callback`` — the input
+        tree must hold the same GLOBAL host values on every process
+        (true for seeded init and the keyed data loaders). Pass a
+        precomputed ``shardings`` tree (from :meth:`stacked_shardings`)
+        to skip recomputation on hot paths.
+        """
+        import jax as _jax
+        import numpy as _np
+
+        if shardings is None:
+            shardings = self.stacked_shardings(tree, rules)
+        if _jax.process_count() == 1:
+            return _jax.tree.map(_jax.device_put, tree, shardings)
+
+        def put(x, sharding):
+            if hasattr(x, "dtype") and _jax.dtypes.issubdtype(
+                x.dtype, _jax.dtypes.prng_key
+            ):
+                # typed PRNG keys can't cross the numpy boundary: ship the
+                # raw key data (extra trailing dim, replicated) and re-wrap
+                impl = _jax.random.key_impl(x)
+                raw = _np.asarray(_jax.device_get(_jax.random.key_data(x)))
+                rsharding = NamedSharding(
+                    sharding.mesh, PartitionSpec(*sharding.spec, None)
+                )
+                garr = _jax.make_array_from_callback(
+                    raw.shape, rsharding, lambda idx: raw[idx]
+                )
+                return _jax.random.wrap_key_data(garr, impl=impl)
+            host = _np.asarray(x)
+            return _jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+
+        return _jax.tree.map(put, tree, shardings)
 
     def stack_shape(self) -> tuple[int, ...]:
         """Leading axes a global stacked array must carry."""

@@ -157,10 +157,16 @@ def blockwise_attention(
         new_out = out * correction.transpose(0, 2, 1)[..., None] + blk_out
         return (new_out, new_max, new_sum, start + block_kv), None
 
+    # derive the accumulators FROM q (zeros via q*0) rather than fresh
+    # constants: inside shard_map the carry must match the body's
+    # varying-manual-axes annotation, and inheriting q's does that on
+    # every path (plain jit included, where it is a no-op)
+    zeros_bshd = jnp.asarray(q, jnp.float32) * 0.0
+    zeros_bhs = jnp.moveaxis(zeros_bshd[..., 0], 1, 2)
     carry0 = (
-        jnp.zeros((b, s, h, d), jnp.float32),
-        jnp.full((b, h, s), _NEG_INF, jnp.float32),
-        jnp.zeros((b, h, s), jnp.float32),
+        zeros_bshd,
+        zeros_bhs + _NEG_INF,
+        zeros_bhs,
         jnp.asarray(0, jnp.int32),
     )
     # remat the block step: without it, grad-of-scan stores every block's

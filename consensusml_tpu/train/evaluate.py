@@ -165,6 +165,19 @@ def make_stacked_eval_step(eval_fn: EvalFn):
     return eval_step
 
 
+def _fetch(v) -> np.ndarray:
+    """Host value of a metric array; per-worker sums may be sharded across
+    PROCESSES in a multi-controller run, where ``device_get`` raises —
+    allgather them instead."""
+    if hasattr(v, "is_fully_addressable") and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(v, tiled=True), np.float64
+        )
+    return np.asarray(jax.device_get(v), np.float64)
+
+
 def _derive(sums: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     out = {}
     count = sums.get("count")
@@ -194,8 +207,8 @@ def evaluate(
     tot_mean: dict[str, np.ndarray] | None = None
     for batch in batches:
         per, mean = step(state.params, state.model_state, batch)
-        per = {k: np.asarray(jax.device_get(v), np.float64) for k, v in per.items()}
-        mean = {k: np.asarray(jax.device_get(v), np.float64) for k, v in mean.items()}
+        per = {k: _fetch(v) for k, v in per.items()}
+        mean = {k: _fetch(v) for k, v in mean.items()}
         if tot_per is None:
             tot_per, tot_mean = per, mean
         else:

@@ -81,6 +81,9 @@ _SLOW_TESTS = {
     # elastic resize (each builds + trains a stacked state first)
     "test_training_continues_after_resize_both_ways",
     "test_resize_resets_choco_state_at_new_world",
+    # two-controller jax.distributed run (subprocess pair + compiles)
+    "test_two_process_collective_training",
+    "test_two_process_checkpoint_and_eval",
 }
 
 

@@ -313,18 +313,21 @@ def main(argv=None) -> int:
         from consensusml_tpu.utils import resize_state
 
         # template leaves stay jax arrays: orbax takes each leaf's
-        # sharding from the template (single-device here; the resize
-        # result is sharded onto the worker mesh by `shard`)
-        old_template = init_stacked_state(
-            bundle.cfg, bundle.init_params, jax.random.key(args.seed), elastic_from
-        )
-        restored = restore_state(args.resume, old_template)
-        state = shard(
-            resize_state(
+        # sharding from the template. Build + restore + resize on the CPU
+        # backend — host RAM holds the full old-world replica set where a
+        # single accelerator's HBM could not (full-scale elastic resume) —
+        # then `shard` moves the result onto the worker mesh.
+        with jax.default_device(jax.devices("cpu")[0]):
+            old_template = init_stacked_state(
+                bundle.cfg, bundle.init_params, jax.random.key(args.seed),
+                elastic_from,
+            )
+            restored = restore_state(args.resume, old_template)
+            resized = resize_state(
                 bundle.cfg, restored, bundle.world_size,
                 rng=jax.random.key(args.seed + 1),
             )
-        )
+        state = shard(resized)
         print(
             f"elastic resume: {elastic_from} -> {bundle.world_size} workers "
             "(joiners from consensus mean; gossip state reset)",
@@ -342,9 +345,13 @@ def main(argv=None) -> int:
     if args.resume:
         import numpy as np
 
-        # per-worker step counters are identical; resume the data stream at
-        # the next absolute round so no batch is replayed
-        start = int(np.asarray(jax.device_get(state.step)).ravel()[0])
+        # per-worker step counters are identical, so ONE addressable shard
+        # suffices (device_get of the whole array would fail on a state
+        # sharded across processes)
+        leaf = state.step
+        if hasattr(leaf, "addressable_shards"):
+            leaf = leaf.addressable_shards[0].data
+        start = int(np.asarray(jax.device_get(leaf)).ravel()[0])
         print(f"resumed from {args.resume} at round {start}", flush=True)
 
     from consensusml_tpu.utils import RoundTimer, trace as profile_trace
@@ -354,8 +361,22 @@ def main(argv=None) -> int:
     metrics = {}
     last_saved = None
     profiling = contextlib.nullcontext()
+    # multi-controller: host batches are global values (keyed loaders are
+    # process-independent), but jit can only auto-place addressable arrays —
+    # assemble each round's global jax.Array from per-process shards.
+    # Orbax handles globally-sharded trees itself, so checkpoints skip the
+    # host fetch (device_get would raise on non-addressable shards).
+    multiproc = backend == "collective" and jax.process_count() > 1
+    ckpt_view = (lambda s: s) if multiproc else (lambda s: jax.device_get(s))
+    batch_shardings = None
     for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
         rnd = start + i
+        if multiproc:
+            # shardings depend only on the (fixed) batch structure —
+            # compute once, reuse every round
+            if batch_shardings is None:
+                batch_shardings = wmesh.stacked_shardings(batch)
+            batch = wmesh.shard_stacked(batch, shardings=batch_shardings)
         if args.profile_dir and i == 2:
             profiling = profile_trace(args.profile_dir)
             profiling.__enter__()
@@ -371,7 +392,7 @@ def main(argv=None) -> int:
             and args.checkpoint_every
             and (rnd + 1) % args.checkpoint_every == 0
         ):
-            save_state(args.checkpoint_dir, jax.device_get(state), step=rnd + 1)
+            save_state(args.checkpoint_dir, ckpt_view(state), step=rnd + 1)
             last_saved = rnd + 1
     if not isinstance(profiling, contextlib.nullcontext):
         # run ended before round 4: close the trace so the dump is valid
@@ -379,7 +400,7 @@ def main(argv=None) -> int:
         print(f"profile trace: {args.profile_dir}", flush=True)
     if args.checkpoint_dir and last_saved != start + args.rounds:
         path = save_state(
-            args.checkpoint_dir, jax.device_get(state), step=start + args.rounds
+            args.checkpoint_dir, ckpt_view(state), step=start + args.rounds
         )
         print(f"checkpoint: {path}", flush=True)
     logger.close()

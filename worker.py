@@ -28,16 +28,40 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", default=None, help="host:port of process 0")
     p.add_argument("--num-processes", type=int, default=1)
     p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="CPU simulation: expose this many virtual CPU devices "
+                        "per process (sets the XLA host-platform device count "
+                        "and enables gloo cross-process collectives) — lets "
+                        "the full multi-PROCESS path run without TPUs")
     p.add_argument("train_args", nargs="*", help="arguments forwarded to train.py (after --)")
     args = p.parse_args(argv)
+
+    if args.local_devices is not None:
+        # must precede the first jax import
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.local_devices}"
+        ).strip()
 
     if args.num_processes > 1:
         import jax
 
+        kwargs = {}
+        if args.local_devices is not None:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            kwargs["local_device_ids"] = list(range(args.local_devices))
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id,
+            **kwargs,
         )
         print(
             f"worker {args.process_id}/{args.num_processes}: "
