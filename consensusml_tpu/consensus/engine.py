@@ -322,6 +322,39 @@ class ConsensusEngine:
             x_new = rebuild(x_new)
         return x_new, ChocoState(xhat=xhat, s=s)
 
+    # ---- accounting -----------------------------------------------------
+    def wire_bytes_per_round(self, params: Any) -> int:
+        """Bytes ONE worker sends per gossip round (bandwidth accounting).
+
+        Exact mixing ships each gossiped leaf densely once per shift
+        (dense topologies: one all-reduce pass counted as one send);
+        compressed gossip ships the codec payload instead. Push-sum adds
+        one f32 mass scalar per shift. Time-varying topologies report the
+        per-period average.
+        """
+        import numpy as np
+
+        if self.config.path_filter is not None:
+            params, _ = self._select(params)
+        comp = self.config.compressor
+
+        def leaf_bytes(x) -> int:
+            shape = tuple(x.shape)
+            if comp is None:
+                return int(np.prod(shape)) * np.dtype(jnp.float32).itemsize
+            return comp.wire_bytes(shape, jnp.float32)
+
+        payload = sum(leaf_bytes(x) for x in jax.tree.leaves(params))
+        topo = self.topology
+        if topo.is_time_varying:
+            sends = sum(
+                (1 if p.uses_psum else len(p.shifts)) for p in topo.phases
+            ) / topo.period
+        else:
+            sends = 1 if topo.uses_psum else len(topo.shifts)
+        mass = 4 * sends if self.config.push_sum else 0
+        return int(payload * sends + mass)
+
     # ---- metrics --------------------------------------------------------
     def consensus_error_collective(self, params: Any) -> jax.Array:
         return collectives.consensus_error(params, self.topology)

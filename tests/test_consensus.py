@@ -137,3 +137,41 @@ def test_compressed_wire_is_small():
     comp = TopKCompressor(ratio=0.01)
     dense = 1_000_000 * 4
     assert comp.wire_bytes((1000, 1000), jnp.float32) <= dense / 12
+
+
+def test_wire_bytes_per_round_accounting():
+    """Bandwidth accounting: codec payloads vs dense, per-shift sends."""
+    import numpy as np
+
+    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.topology import (
+        DenseTopology,
+        OnePeerExponentialTopology,
+        RingTopology,
+    )
+
+    params = {"w": jnp.zeros((100, 100)), "b": jnp.zeros((100,))}
+    dense_bytes = (100 * 100 + 100) * 4
+
+    # exact ring: dense payload x 2 shifts
+    eng = ConsensusEngine(GossipConfig(topology=RingTopology(8)))
+    assert eng.wire_bytes_per_round(params) == dense_bytes * 2
+    # dense topology: one all-reduce pass
+    eng = ConsensusEngine(GossipConfig(topology=DenseTopology(4)))
+    assert eng.wire_bytes_per_round(params) == dense_bytes
+    # compressed: payload well under dense
+    comp = topk_int8_compressor(ratio=0.01, chunk=128)
+    eng = ConsensusEngine(
+        GossipConfig(topology=RingTopology(8), compressor=comp, gamma=0.5)
+    )
+    compressed = eng.wire_bytes_per_round(params)
+    assert compressed < dense_bytes // 5
+    assert compressed == 2 * sum(
+        comp.wire_bytes(x.shape, jnp.float32) for x in params.values()
+    )
+    # one-peer time-varying: single send per round on average
+    eng = ConsensusEngine(GossipConfig(topology=OnePeerExponentialTopology(8)))
+    assert eng.wire_bytes_per_round(params) == dense_bytes
+    # push-sum adds the mass scalar
+    eng = ConsensusEngine(GossipConfig(topology=RingTopology(8), push_sum=True))
+    assert eng.wire_bytes_per_round(params) == dense_bytes * 2 + 8

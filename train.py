@@ -277,6 +277,12 @@ def main(argv=None) -> int:
         f"model_axes={axes_str}: {bundle.description}",
         flush=True,
     )
+    # bandwidth accounting: what one worker puts on the wire per round
+    param_shapes = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    if isinstance(param_shapes, tuple) and len(param_shapes) == 2:
+        param_shapes = param_shapes[0]  # (params, model_state) initializers
+    wire = bundle.cfg.engine().wire_bytes_per_round(param_shapes)
+    print(f"gossip wire: {wire / 1e6:.3f} MB/worker/round", flush=True)
 
     if backend == "collective":
         from consensusml_tpu.comm import slice_major_devices
