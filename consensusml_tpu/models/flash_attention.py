@@ -1,0 +1,336 @@
+"""Pallas TPU flash attention (forward + backward kernels, custom VJP).
+
+Reference parity: the reference's fused attention would be a CUDA kernel
+(unknowable — mount empty); on TPU the XLA-fused blockwise recurrence in
+:mod:`consensusml_tpu.models.attention` already gives the O(S) memory
+bound, but measured on a v5e it runs fwd+bwd at ~11 TFLOP/s (dense:
+~16). This kernel keeps each (q-block, kv-block) tile entirely in VMEM
+with MXU matmuls and the online-softmax recurrence — the
+flash-attention-2 schedule — and a custom VJP whose backward recomputes
+tiles from the saved logsumexp instead of storing S x S probabilities.
+
+Layout notes (TPU-specific):
+- inputs (B, S, H, D) fold to (B*H, S, D); grids walk (batch*heads,
+  q blocks) forward/dq and (batch*heads, kv blocks) for dk/dv;
+- per-row scalars (logsumexp, delta) are stored REPLICATED across a
+  128-lane minor dim — rows stay on sublanes, so kernels never need a
+  sublane<->lane transpose (the layout the public jax pallas op uses);
+- the sequence pads to a block multiple; padded keys are masked by
+  absolute position, padded query rows are sliced off at the end;
+- causal grids skip blocks strictly above the diagonal.
+
+Supports causal and full self-attention, no bias (the BERT padding-bias
+path stays on the XLA blockwise implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+_BQ = 512
+_BK = 512
+_LANE = 128
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(causal, s_real, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
+    """One (batch*head, q-block) tile: stream kv blocks, online softmax."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    bq, d = q.shape
+    s_pad = k_ref.shape[1]
+    nk = s_pad // bk
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (bq, bk)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = k_pos < s_real
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)  # (bq, bk)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        nk_eff = jnp.clip(pl.cdiv((qi + 1) * bq, bk), 1, nk)
+    else:
+        nk_eff = nk
+    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # per-row logsumexp, replicated across the lane dim (no transpose)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, _LANE))
+
+
+def _fwd(q3, k3, v3, causal: bool, s_real: int, scale: float, interpret: bool = False):
+    """q3/k3/v3: (BH, S_pad, D) -> (o (BH,S_pad,D), lse (BH,S_pad,LANE))."""
+    bh, s_pad, d = q3.shape
+    nq = s_pad // _BQ
+    kernel = functools.partial(_fwd_kernel, causal, s_real, scale, _BK)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, _BQ, _LANE), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, _LANE), jnp.float32),
+        ],
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    causal, s_real, scale, bk,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]  # (bq, 1) — lane-replicated scalar
+    delta = delta_ref[0][:, :1]
+    bq, d = q.shape
+    s_pad = k_ref.shape[1]
+    nk = s_pad // bk
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = k_pos < s_real
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        nk_eff = jnp.clip(pl.cdiv((qi + 1) * bq, bk), 1, nk)
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    causal, s_real, scale, bq,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    s_pad = q_ref.shape[1]
+    nq = s_pad // bq
+    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq), :][:, :1]
+        delta = delta_ref[0, pl.ds(i * bq, bq), :][:, :1]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (bq, bk)
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = k_pos < s_real
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    # q blocks strictly above this kv block's diagonal never see it
+    i0 = (kj * bk) // bq if causal else 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, nq, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, s_real, scale, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    bh, s_pad, d = q3.shape
+    do3 = do3.astype(jnp.float32)
+    delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1)  # (BH, S_pad)
+    delta = jnp.broadcast_to(delta[..., None], (bh, s_pad, _LANE))
+    nq = s_pad // _BQ
+    nk = s_pad // _BK
+    lane_spec_blk = pl.BlockSpec(
+        (1, _BQ, _LANE), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    lane_spec_full = pl.BlockSpec(
+        (1, s_pad, _LANE), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal, s_real, scale, _BK),
+        grid=(bh, nq),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            lane_spec_blk,
+            lane_spec_blk,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
+    )(q3, k3, v3, do3, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal, s_real, scale, _BQ),
+        grid=(bh, nk),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            lane_spec_full,
+            lane_spec_full,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
+        ],
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP over the padded/folded layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q3, k3, v3, causal, s_real, scale, interpret):
+    o3, _ = _fwd(q3, k3, v3, causal, s_real, scale, interpret)
+    return o3
+
+
+def _flash3_fwd(q3, k3, v3, causal, s_real, scale, interpret):
+    o3, lse = _fwd(q3, k3, v3, causal, s_real, scale, interpret)
+    return o3, (q3, k3, v3, o3, lse)
+
+
+def _flash3_bwd(causal, s_real, scale, interpret, res, do3):
+    return _bwd(causal, s_real, scale, interpret, res, do3)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused Pallas self-attention (no bias; same contract as
+    ``dot_product_attention``). Requires ``q.shape == k.shape``."""
+    b, s, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"flash_attention is self-attention-shaped: q{q.shape} k{k.shape}"
+        )
+    scale = 1.0 / float(d) ** 0.5
+    # pad to a common multiple of both block sizes: the kv loops count
+    # s_pad // _BK blocks, so a _BQ-only pad would silently drop tail keys
+    # under retuned, non-dividing block constants
+    block = math.lcm(_BQ, _BK)
+    pad = (-s) % block
+
+    def fold(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    o3 = _flash3(fold(q), fold(k), fold(v), causal, s, scale, interpret)
+    o = o3[:, :s].reshape(b, h, s, d)
+    return jnp.moveaxis(o, 1, 2).astype(dtype)

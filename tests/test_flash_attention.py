@@ -1,0 +1,98 @@
+"""Pallas flash-attention kernel parity (interpreter mode on the CPU
+mesh; the compiled-on-TPU check lives in test_kernels_tpu.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.models import flash_attention as fa_mod
+from consensusml_tpu.models.flash_attention import flash_attention
+
+
+@pytest.fixture(autouse=True)
+def small_blocks(monkeypatch):
+    # interpreter mode is slow: shrink the (TPU-tuned 512) blocks so
+    # multi-block paths are exercised at test-sized sequences
+    monkeypatch.setattr(fa_mod, "_BQ", 64)
+    monkeypatch.setattr(fa_mod, "_BK", 64)
+
+
+def _qkv(rng, b, s, h, d):
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [128, 100])  # exact blocks and padded tail
+def test_flash_forward_matches_dense(causal, s):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, 2, s, 2, 64)
+    want = dot_product_attention(q, k, v, causal=causal, dtype=jnp.float32, impl="dense")
+    got = flash_attention(q, k, v, causal=causal, dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_dense(causal):
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, 1, 128, 2, 64)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    flash_fn = loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, dtype=jnp.float32, interpret=True
+        )
+    )
+    dense_fn = loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=causal, dtype=jnp.float32, impl="dense"
+        )
+    )
+    gf = jax.grad(flash_fn, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_fn, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_flash_rejects_cross_attention():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+    with pytest.raises(ValueError, match="self-attention"):
+        flash_attention(q, k, q, causal=False)
+
+
+def test_auto_dispatch_never_picks_flash_off_tpu():
+    # the CPU test mesh must route long sequences to blockwise, not the
+    # TPU kernel
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 1024, 1, 64)), jnp.bfloat16)
+    auto = dot_product_attention(q, q, q, causal=True)
+    blk = dot_product_attention(q, q, q, causal=True, impl="blockwise")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(blk))
+
+
+def test_explicit_flash_rejects_bias():
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(1, 64, 1, 64)), jnp.float32)
+    bias = jnp.zeros((1, 1, 1, 64), jnp.float32)
+    with pytest.raises(ValueError, match="bias"):
+        dot_product_attention(q, q, q, bias=bias, impl="flash")
+
+
+def test_non_dividing_blocks_pad_to_common_multiple(monkeypatch):
+    # _BQ=64, _BK=48 at s=100: a _BQ-only pad would drop tail keys
+    monkeypatch.setattr(fa_mod, "_BK", 48)
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, 1, 100, 1, 64)
+    want = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense")
+    got = flash_attention(q, k, v, causal=True, dtype=jnp.float32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)

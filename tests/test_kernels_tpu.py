@@ -90,3 +90,47 @@ def test_pallas_kernels_match_reference_on_tpu():
     assert result["scales_exact"], result
     assert result["dequant_exact"], result
     assert result["topk_exact"], result
+
+
+_FLASH_CHILD = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() not in ("tpu", "axon"):
+    print(json.dumps({"skip": f"no TPU (backend={jax.default_backend()})"}))
+    raise SystemExit(0)
+
+from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.models.flash_attention import flash_attention
+
+out = {"backend": jax.default_backend()}
+rng = np.random.default_rng(0)
+b, s, h, d = 2, 1024, 4, 64
+q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32) for _ in range(3))
+want = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense")
+got = flash_attention(q, k, v, causal=True, dtype=jnp.float32)
+# default TPU matmul precision is bf16-class; both paths share it
+out["fwd_max_err"] = float(jnp.max(jnp.abs(got - want)))
+gf = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal=True, dtype=jnp.float32) ** 2))(q)
+gd = jax.grad(lambda q: jnp.sum(dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense") ** 2))(q)
+scale = float(jnp.max(jnp.abs(gd)))
+out["dq_rel_err"] = float(jnp.max(jnp.abs(gf - gd))) / max(scale, 1e-9)
+print(json.dumps(out))
+"""
+
+
+def test_flash_attention_on_tpu():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _FLASH_CHILD],
+        capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    result = json.loads(line)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["fwd_max_err"] < 0.02, result  # bf16-precision matmuls
+    assert result["dq_rel_err"] < 0.02, result
