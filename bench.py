@@ -151,6 +151,45 @@ def _codec_bench() -> dict:
     return out
 
 
+def _attention_bench() -> dict:
+    """Attention impl micro-bench at the full-scale GPT-2-ish shape:
+    dense vs XLA blockwise vs the Pallas flash kernel, fwd+bwd."""
+    import jax
+
+    if os.environ.get("BENCH_DEVICE"):
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensusml_tpu.models.attention import dot_product_attention
+    from consensusml_tpu.models.flash_attention import flash_attention
+
+    b, s, h, d = 4, 2048, 16, 64
+    q = jnp.asarray(
+        np.random.default_rng(0).normal(size=(b, s, h, d)), jnp.bfloat16
+    )
+    out = {"shape": [b, s, h, d], "platform": jax.default_backend()}
+    impls = {
+        "dense": lambda q: dot_product_attention(q, q, q, causal=True, impl="dense"),
+        "blockwise": lambda q: dot_product_attention(
+            q, q, q, causal=True, impl="blockwise"
+        ),
+    }
+    if jax.default_backend() in ("tpu", "axon"):
+        impls["flash_pallas"] = lambda q: flash_attention(q, q, q, causal=True)
+    for name, fn in impls.items():
+        g = jax.jit(jax.grad(lambda q: jnp.sum(jnp.asarray(fn(q), jnp.float32))))
+        r = g(q)
+        float(jnp.sum(jnp.asarray(r[0, 0, 0], jnp.float32)))  # compile fence
+        reps = 10
+        t0 = time.time()
+        for _ in range(reps):
+            r = g(q)
+        float(jnp.sum(jnp.asarray(r[0, 0, 0], jnp.float32)))
+        out[name] = {"fwd_bwd_ms": round(1000 * (time.time() - t0) / reps, 2)}
+    return out
+
+
 def _consensus_bench() -> dict:
     """The consensus-error half of the headline metric: ~20 rounds of the
     8-worker ring on this process's devices (the driver subprocess forces
@@ -215,6 +254,9 @@ def main() -> None:
     if "--_codec" in sys.argv:
         print("INNER_RESULT " + json.dumps(_codec_bench()), flush=True)
         return
+    if "--_attention" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_attention_bench()), flush=True)
+        return
     if "--_consensus" in sys.argv:
         print("INNER_RESULT " + json.dumps(_consensus_bench()), flush=True)
         return
@@ -276,6 +318,10 @@ def main() -> None:
         extras["codec"] = run_sub("--_codec", 900)
     except (subprocess.TimeoutExpired, RuntimeError) as e:
         extras["codec"] = {"error": str(e)[:300]}
+    try:
+        extras["attention"] = run_sub("--_attention", 900)
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        extras["attention"] = {"error": str(e)[:300]}
 
     print(
         json.dumps(
