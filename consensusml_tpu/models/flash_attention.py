@@ -46,14 +46,47 @@ _LANE = 128
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(causal, s_real, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
-    """One (batch*head, q-block) tile: stream kv blocks, online softmax."""
+def fold_pad(x: jax.Array, block: int) -> jax.Array:
+    """(B, S, H, D) -> (B*H, S_pad, D), S zero-padded up to a multiple of
+    ``block`` — THE layout every kernel in this module assumes. The ring
+    path (parallel.ring_attention) shares it; keep one definition."""
+    b, s, h, d = x.shape
+    x3 = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+    pad = (-s) % block
+    if pad:
+        x3 = jnp.pad(x3, ((0, 0), (0, pad), (0, 0)))
+    return x3
+
+
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct with an optional varying-manual-axes annotation —
+    required for pallas_call outputs INSIDE shard_map (the ring path)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+
+
+def _fwd_kernel(
+    causal, aligned, s_real, scale, bk,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+):
+    """One (batch*head, q-block) tile: stream kv blocks, online softmax.
+
+    ``aligned`` (static) means q and k share the origin (plain
+    self-attention), enabling the above-diagonal block skip; the ring
+    path passes dynamic offsets (SMEM scalars) and keeps the full loop.
+    """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     bq, d = q.shape
     s_pad = k_ref.shape[1]
     nk = s_pad // bk
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    q_pos = (
+        qoff_ref[0, 0]
+        + qi * bq
+        + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    )
+    koff = koff_ref[0, 0]
 
     def body(j, carry):
         acc, m, l = carry
@@ -66,10 +99,10 @@ def _fwd_kernel(causal, s_real, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
             )
             * scale
         )  # (bq, bk)
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = k_pos < s_real
+        k_local = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = k_local < s_real  # padded tail keys
         if causal:
-            mask = mask & (q_pos >= k_pos)
+            mask = mask & (q_pos >= koff + k_local)
         s = jnp.where(mask, s, _NEG_INF)
         m_blk = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m, m_blk)
@@ -84,7 +117,7 @@ def _fwd_kernel(causal, s_real, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
-    if causal:
+    if causal and aligned:
         # kv blocks strictly above the diagonal contribute nothing
         nk_eff = jnp.clip(pl.cdiv((qi + 1) * bq, bk), 1, nk)
     else:
@@ -92,20 +125,35 @@ def _fwd_kernel(causal, s_real, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # per-row logsumexp, replicated across the lane dim (no transpose)
+    # per-row logsumexp, replicated across the lane dim (no transpose).
+    # Fully-masked rows keep m = -inf => lse ~ -inf, so a later merge
+    # weights them to zero (the ring path relies on this).
     lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, _LANE))
 
 
-def _fwd(q3, k3, v3, causal: bool, s_real: int, scale: float, interpret: bool = False):
-    """q3/k3/v3: (BH, S_pad, D) -> (o (BH,S_pad,D), lse (BH,S_pad,LANE))."""
+def _fwd(
+    q3, k3, v3, causal: bool, s_real: int, scale: float,
+    interpret: bool = False,
+    q_offset=None, k_offset=None, vma=None,
+):
+    """q3/k3/v3: (BH, S_pad, D) -> (o (BH,S_pad,D), lse (BH,S_pad,LANE)).
+
+    ``q_offset``/``k_offset``: absolute positions of row 0 (traced int32
+    scalars, e.g. a ring rank index) — None means 0/0, which also enables
+    the causal block-skip fast path.
+    """
     bh, s_pad, d = q3.shape
     nq = s_pad // _BQ
-    kernel = functools.partial(_fwd_kernel, causal, s_real, scale, _BK)
+    aligned, qoff, koff = _offsets_smem(q_offset, k_offset)
+    kernel = functools.partial(_fwd_kernel, causal, aligned, s_real, scale, _BK)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     return pl.pallas_call(
         kernel,
         grid=(bh, nq),
         interpret=interpret,
         in_specs=[
+            smem,
+            smem,
             pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
@@ -117,10 +165,10 @@ def _fwd(q3, k3, v3, causal: bool, s_real: int, scale: float, interpret: bool = 
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad, _LANE), jnp.float32),
+            _sds((bh, s_pad, d), q3.dtype, vma),
+            _sds((bh, s_pad, _LANE), jnp.float32, vma),
         ],
-    )(q3, k3, v3)
+    )(qoff, koff, q3, k3, v3)
 
 
 # ---------------------------------------------------------------------------
@@ -129,8 +177,8 @@ def _fwd(q3, k3, v3, causal: bool, s_real: int, scale: float, interpret: bool = 
 
 
 def _bwd_dq_kernel(
-    causal, s_real, scale, bk,
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    causal, aligned, s_real, scale, bk,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 ):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
@@ -140,7 +188,12 @@ def _bwd_dq_kernel(
     bq, d = q.shape
     s_pad = k_ref.shape[1]
     nk = s_pad // bk
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    q_pos = (
+        qoff_ref[0, 0]
+        + qi * bq
+        + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    )
+    koff = koff_ref[0, 0]
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
@@ -152,10 +205,10 @@ def _bwd_dq_kernel(
             )
             * scale
         )
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = k_pos < s_real
+        k_local = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = k_local < s_real
         if causal:
-            mask = mask & (q_pos >= k_pos)
+            mask = mask & (q_pos >= koff + k_local)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -165,7 +218,7 @@ def _bwd_dq_kernel(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
+    if causal and aligned:
         nk_eff = jnp.clip(pl.cdiv((qi + 1) * bq, bk), 1, nk)
     else:
         nk_eff = nk
@@ -174,7 +227,8 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    causal, s_real, scale, bq,
+    causal, aligned, s_real, scale, bq,
+    qoff_ref, koff_ref,
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 ):
     kj = pl.program_id(1)
@@ -183,7 +237,13 @@ def _bwd_dkv_kernel(
     bk, d = k.shape
     s_pad = q_ref.shape[1]
     nq = s_pad // bq
-    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    k_pos = (
+        koff_ref[0, 0]
+        + kj * bk
+        + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    )
+    k_local = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    qoff = qoff_ref[0, 0]
 
     def body(i, carry):
         dk, dv = carry
@@ -198,8 +258,8 @@ def _bwd_dkv_kernel(
             )
             * scale
         )  # (bq, bk)
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        mask = k_pos < s_real
+        q_pos = qoff + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = k_local < s_real
         if causal:
             mask = mask & (q_pos >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -216,7 +276,7 @@ def _bwd_dkv_kernel(
         return dk_new, dv_new
 
     # q blocks strictly above this kv block's diagonal never see it
-    i0 = (kj * bk) // bq if causal else 0
+    i0 = (kj * bk) // bq if (causal and aligned) else 0
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(i0, nq, body, (dk0, dv0))
@@ -224,28 +284,39 @@ def _bwd_dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, s_real, scale, interpret, res, do3):
-    q3, k3, v3, o3, lse = res
-    bh, s_pad, d = q3.shape
-    do3 = do3.astype(jnp.float32)
-    delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1)  # (BH, S_pad)
-    delta = jnp.broadcast_to(delta[..., None], (bh, s_pad, _LANE))
-    nq = s_pad // _BQ
-    nk = s_pad // _BK
+def _offsets_smem(q_offset, k_offset):
+    aligned = q_offset is None and k_offset is None
+    qoff = jnp.reshape(
+        jnp.asarray(0 if q_offset is None else q_offset, jnp.int32), (1, 1)
+    )
+    koff = jnp.reshape(
+        jnp.asarray(0 if k_offset is None else k_offset, jnp.int32), (1, 1)
+    )
+    return aligned, qoff, koff
+
+
+def _bwd_dq(
+    q3, k3, v3, do3, lse, delta, causal, s_real, scale, interpret,
+    q_offset=None, k_offset=None, vma=None,
+):
+    """dq for local queries against a (possibly offset) kv span."""
+    bh, sq_pad, d = q3.shape
+    sk_pad = k3.shape[1]
+    aligned, qoff, koff = _offsets_smem(q_offset, k_offset)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     lane_spec_blk = pl.BlockSpec(
         (1, _BQ, _LANE), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
     )
-    lane_spec_full = pl.BlockSpec(
-        (1, s_pad, _LANE), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM
-    )
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal, s_real, scale, _BK),
-        grid=(bh, nq),
+    return pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal, aligned, s_real, scale, _BK),
+        grid=(bh, sq_pad // _BQ),
         interpret=interpret,
         in_specs=[
+            smem,
+            smem,
             pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             lane_spec_blk,
             lane_spec_blk,
@@ -253,17 +324,33 @@ def _bwd(causal, s_real, scale, interpret, res, do3):
         out_specs=pl.BlockSpec(
             (1, _BQ, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
-    )(q3, k3, v3, do3, lse, delta)
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal, s_real, scale, _BQ),
-        grid=(bh, nk),
+        out_shape=_sds((bh, sq_pad, d), q3.dtype, vma),
+    )(qoff, koff, q3, k3, v3, do3, lse, delta)
+
+
+def _bwd_dkv(
+    q3, k3, v3, do3, lse, delta, causal, s_real, scale, interpret,
+    q_offset=None, k_offset=None, vma=None,
+):
+    """dk/dv for a (possibly offset) kv span against local queries."""
+    bh, sq_pad, d = q3.shape
+    sk_pad = k3.shape[1]
+    aligned, qoff, koff = _offsets_smem(q_offset, k_offset)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    lane_spec_full = pl.BlockSpec(
+        (1, sq_pad, _LANE), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal, aligned, s_real, scale, _BQ),
+        grid=(bh, sk_pad // _BK),
         interpret=interpret,
         in_specs=[
-            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            smem,
+            smem,
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
             lane_spec_full,
             lane_spec_full,
         ],
@@ -272,10 +359,20 @@ def _bwd(causal, s_real, scale, interpret, res, do3):
             pl.BlockSpec((1, _BK, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad, d), q3.dtype),
+            _sds((bh, sk_pad, d), q3.dtype, vma),
+            _sds((bh, sk_pad, d), q3.dtype, vma),
         ],
-    )(q3, k3, v3, do3, lse, delta)
+    )(qoff, koff, q3, k3, v3, do3, lse, delta)
+
+
+def _bwd(causal, s_real, scale, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    bh, s_pad, d = q3.shape
+    do3 = do3.astype(jnp.float32)
+    delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1)  # (BH, S_pad)
+    delta = jnp.broadcast_to(delta[..., None], (bh, s_pad, _LANE))
+    dq = _bwd_dq(q3, k3, v3, do3, lse, delta, causal, s_real, scale, interpret)
+    dk, dv = _bwd_dkv(q3, k3, v3, do3, lse, delta, causal, s_real, scale, interpret)
     return dq, dk, dv
 
 
@@ -323,14 +420,9 @@ def flash_attention(
     # s_pad // _BK blocks, so a _BQ-only pad would silently drop tail keys
     # under retuned, non-dividing block constants
     block = math.lcm(_BQ, _BK)
-    pad = (-s) % block
-
-    def fold(x):
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
-        if pad:
-            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-        return x
-
-    o3 = _flash3(fold(q), fold(k), fold(v), causal, s, scale, interpret)
+    o3 = _flash3(
+        fold_pad(q, block), fold_pad(k, block), fold_pad(v, block),
+        causal, s, scale, interpret,
+    )
     o = o3[:, :s].reshape(b, h, s, d)
     return jnp.moveaxis(o, 1, 2).astype(dtype)

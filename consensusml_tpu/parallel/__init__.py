@@ -10,6 +10,7 @@ the same device mesh.
 
 from consensusml_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
+    ring_flash_attention,
 )
 from consensusml_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
