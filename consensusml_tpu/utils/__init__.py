@@ -1,6 +1,7 @@
 """Runtime utilities: checkpointing, metrics logging, tracing."""
 
 from consensusml_tpu.utils.checkpoint import (  # noqa: F401
+    AsyncSaver,
     checkpoint_world_size,
     restore_state,
     save_state,

@@ -11,12 +11,18 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
-__all__ = ["save_state", "restore_state", "checkpoint_world_size"]
+__all__ = [
+    "save_state",
+    "restore_state",
+    "checkpoint_world_size",
+    "AsyncSaver",
+]
 
 
 def save_state(path: str, state: Any, step: int | None = None) -> str:
@@ -43,6 +49,53 @@ def save_state(path: str, state: Any, step: int | None = None) -> str:
             json.dump({"world_size": int(step_leaf.shape[0])}, f)
         os.replace(tmp, meta)
     return path
+
+
+class AsyncSaver:
+    """Overlap checkpoint writes with training.
+
+    ``submit`` snapshots the state to host (the only device-blocking
+    part) and hands the disk write to a background thread, so round
+    ``r+1`` trains while round ``r``'s checkpoint serializes. One write
+    in flight at a time — a new submit waits for the previous one (disk
+    is the bottleneck; queueing snapshots would just grow host memory).
+    Call ``wait()`` before reading results / process exit. Errors raise
+    on the NEXT submit or wait, never silently.
+
+    Multi-controller runs keep the SYNCHRONOUS path (orbax coordinates
+    all processes inside save; deferring it to unsynchronized threads
+    would skew the barrier), so ``submit`` falls back to a direct save
+    when ``jax.process_count() > 1``.
+    """
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self.last_path: str | None = None
+
+    def submit(self, path: str, state: Any, step: int | None = None) -> None:
+        self.wait()
+        if jax.process_count() > 1:
+            self.last_path = save_state(path, state, step=step)
+            return
+        snapshot = jax.device_get(state)
+
+        def write():
+            try:
+                self.last_path = save_state(path, snapshot, step=step)
+            except BaseException as e:  # surfaced on next submit/wait
+                self._error = e
+
+        self._thread = threading.Thread(target=write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"async checkpoint write failed: {err!r}") from err
 
 
 def checkpoint_world_size(path: str) -> int | None:

@@ -147,3 +147,25 @@ def test_train_topology_override_bad_name():
     )
     assert r.returncode == 2
     assert "bad --topology" in r.stderr
+
+
+def test_async_saver_unit(tmp_path):
+    """AsyncSaver writes usable checkpoints and surfaces write errors."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.utils import AsyncSaver, restore_state
+
+    saver = AsyncSaver()
+    state = {"a": jnp.arange(4.0), "b": jnp.ones((2, 2))}
+    saver.submit(str(tmp_path / "ck"), state, step=1)
+    saver.wait()
+    got = restore_state(saver.last_path, jax.tree.map(jnp.zeros_like, state))
+    for k in state:
+        assert (got[k] == state[k]).all()
+    # a failing write raises on wait, not silently
+    saver.submit("/proc/definitely/not/writable", state)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="async checkpoint"):
+        saver.wait()

@@ -107,7 +107,7 @@ def main(argv=None) -> int:
         make_collective_train_step,
         make_simulated_train_step,
     )
-    from consensusml_tpu.utils import MetricsLogger, restore_state, save_state
+    from consensusml_tpu.utils import MetricsLogger, restore_state
 
     if args.list:
         for name in configs.names():
@@ -370,10 +370,12 @@ def main(argv=None) -> int:
     # multi-controller: host batches are global values (keyed loaders are
     # process-independent), but jit can only auto-place addressable arrays —
     # assemble each round's global jax.Array from per-process shards.
-    # Orbax handles globally-sharded trees itself, so checkpoints skip the
-    # host fetch (device_get would raise on non-addressable shards).
     multiproc = backend == "collective" and jax.process_count() > 1
-    ckpt_view = (lambda s: s) if multiproc else (lambda s: jax.device_get(s))
+    from consensusml_tpu.utils import AsyncSaver
+
+    # disk writes overlap the next rounds' compute (sync in multiproc —
+    # orbax coordinates the processes inside save)
+    saver = AsyncSaver()
     batch_shardings = None
     for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
         rnd = start + i
@@ -398,17 +400,17 @@ def main(argv=None) -> int:
             and args.checkpoint_every
             and (rnd + 1) % args.checkpoint_every == 0
         ):
-            save_state(args.checkpoint_dir, ckpt_view(state), step=rnd + 1)
+            saver.submit(args.checkpoint_dir, state, step=rnd + 1)
             last_saved = rnd + 1
     if not isinstance(profiling, contextlib.nullcontext):
         # run ended before round 4: close the trace so the dump is valid
         profiling.__exit__(None, None, None)
         print(f"profile trace: {args.profile_dir}", flush=True)
     if args.checkpoint_dir and last_saved != start + args.rounds:
-        path = save_state(
-            args.checkpoint_dir, ckpt_view(state), step=start + args.rounds
-        )
-        print(f"checkpoint: {path}", flush=True)
+        saver.submit(args.checkpoint_dir, state, step=start + args.rounds)
+    if args.checkpoint_dir:
+        saver.wait()
+        print(f"checkpoint: {saver.last_path}", flush=True)
     logger.close()
     if metrics:
         print(f"timing: {timer.stats().format()}", flush=True)
