@@ -291,6 +291,11 @@ class ChunkedTopKCompressor(Compressor):
     k_per_chunk: int = 16
     impl: str = "auto"
 
+    # the kernel extracts one winner per pass (O(k) VMEM sweeps): great
+    # for the small k sparsification uses, a loss past this point — fall
+    # back to lax.top_k per chunk, which sorts once
+    _KERNEL_MAX_K = 64
+
     def __post_init__(self):
         if self.chunk % _LANE:
             raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
@@ -305,6 +310,8 @@ class ChunkedTopKCompressor(Compressor):
         pad = (-n) % chunk
         chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
         impl = _resolve_impl(self.impl)
+        if impl == "pallas" and k > self._KERNEL_MAX_K:
+            impl = "jnp"
         if impl == "jnp":
             _, lidx = jax.lax.top_k(jnp.abs(chunks), k)
             lidx = jnp.asarray(lidx, jnp.int32)

@@ -130,3 +130,17 @@ def test_invalid_chunk_rejected():
         PallasInt8Compressor(chunk=100)
     with pytest.raises(ValueError, match="k_per_chunk"):
         ChunkedTopKCompressor(chunk=128, k_per_chunk=0)
+
+
+def test_chunked_topk_large_k_falls_back_to_sort():
+    """k past the kernel's O(k)-pass sweet spot routes to lax.top_k while
+    keeping identical chunked payload semantics."""
+    from consensusml_tpu.compress import ChunkedTopKCompressor
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(4, 512)), jnp.float32)
+    big = ChunkedTopKCompressor(chunk=256, k_per_chunk=128, impl="pallas")
+    ref = ChunkedTopKCompressor(chunk=256, k_per_chunk=128, impl="jnp")
+    p_big, p_ref = big.compress(x), ref.compress(x)
+    np.testing.assert_array_equal(np.asarray(p_big.indices), np.asarray(p_ref.indices))
+    np.testing.assert_allclose(np.asarray(p_big.values), np.asarray(p_ref.values))
