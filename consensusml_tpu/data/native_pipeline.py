@@ -43,10 +43,13 @@ def native_round_batches(
     seed: int = 0,
     depth: int = 4,
     nthreads: int = 2,
+    start: int = 0,
 ):
     """Yield ``rounds`` stacked ``(W, H, B, *image_shape)`` batches.
 
     Deterministic in ``seed`` (independent of depth/nthreads/timing).
+    ``start`` fast-forwards the stream by consuming that many slots — the
+    slot sequence is the round number, so resume keeps the exact stream.
     """
     import jax.numpy as jnp
 
@@ -66,6 +69,8 @@ def native_round_batches(
         nthreads=nthreads,
         seed=seed,
     ) as loader:
+        for _ in range(start):
+            loader.next()
         for _ in range(rounds):
             floats, ints = loader.next()
             yield {
@@ -87,6 +92,7 @@ def native_lm_round_batches(
     mask_token: int | None = None,
     depth: int = 4,
     nthreads: int = 2,
+    start: int = 0,
 ):
     """Yield stacked ``(W, H, B, S)`` LM batches from the native pipeline.
 
@@ -110,7 +116,9 @@ def native_lm_round_batches(
         nthreads=nthreads,
         seed=seed,
     ) as loader:
-        for r in range(rounds):
+        for _ in range(start):
+            loader.next()
+        for r in range(start, start + rounds):
             _, ints = loader.next()
             ids = ints.reshape(world_size, h, batch, dataset.seq_len)
             if mlm_rate <= 0:
@@ -128,6 +136,7 @@ def native_file_round_batches(
     seed: int = 0,
     depth: int = 4,
     nthreads: int = 2,
+    start: int = 0,
 ):
     """File-backed classification batches through the C++ prefetch ring.
 
@@ -156,6 +165,8 @@ def native_file_round_batches(
         nthreads=nthreads,
         seed=seed,
     ) as loader:
+        for _ in range(start):
+            loader.next()
         for _ in range(rounds):
             floats, ints = loader.next()
             yield {
@@ -177,6 +188,7 @@ def native_file_token_batches(
     mask_token: int | None = None,
     depth: int = 4,
     nthreads: int = 2,
+    start: int = 0,
 ):
     """Token-window batches through the C++ prefetch ring (kind 3): each
     producer thread memcpys seq_len windows from its worker's contiguous
@@ -199,7 +211,9 @@ def native_file_token_batches(
         nthreads=nthreads,
         seed=seed,
     ) as loader:
-        for r in range(rounds):
+        for _ in range(start):
+            loader.next()
+        for r in range(start, start + rounds):
             _, ints = loader.next()
             ids = ints.reshape(world_size, h, batch, dataset.seq_len)
             if mlm_rate <= 0:

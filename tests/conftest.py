@@ -57,6 +57,8 @@ _SLOW_TESTS = {
     "test_train_model_axes_multi_axis_rejected",
     "test_train_model_axes_zero_rejected",
     "test_train_topology_override_hierarchical",
+    "test_train_native_loader",
+    "test_train_native_loader_with_data_dir",
     "test_train_topology_override_bad_name",
     # time-varying topology convergence
     "test_onepeer_beats_ring_consensus_decay",

@@ -169,3 +169,25 @@ def test_async_saver_unit(tmp_path):
 
     with _pytest.raises(RuntimeError, match="async checkpoint"):
         saver.wait()
+
+
+def test_train_native_loader():
+    """--native-loader trains end-to-end through the C++ prefetch ring."""
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "3", "--native-loader"],
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "final:" in r.stdout
+
+
+def test_train_native_loader_with_data_dir(tmp_path):
+    from tests.test_files_data import make_mnist_dir
+
+    make_mnist_dir(str(tmp_path / "m"), n_train=256)
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "2", "--native-loader", "--data-dir", str(tmp_path / "m")],
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "final:" in r.stdout

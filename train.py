@@ -61,6 +61,11 @@ def parse_args(argv=None):
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
+    p.add_argument("--native-loader", action="store_true",
+                   help="assemble round batches with the C++ prefetch ring "
+                        "(producer threads run ahead of the device; see "
+                        "data.native_pipeline). Sample draws differ from the "
+                        "Python loaders' numpy streams by design")
     p.add_argument("--data-dir", default=None,
                    help="train on real files from this directory (MNIST idx / "
                         "CIFAR-10 binaries / tokens.bin — see data.files); "
@@ -376,8 +381,26 @@ def main(argv=None) -> int:
     # disk writes overlap the next rounds' compute (sync in multiproc —
     # orbax coordinates the processes inside save)
     saver = AsyncSaver()
+    batch_source = bundle.batches
+    if args.native_loader:
+        from consensusml_tpu import native
+
+        if bundle.native_batches is None:
+            print(
+                f"error: config {bundle.name} has no native loader path",
+                file=sys.stderr,
+            )
+            return 2
+        if not native.available():
+            print(
+                "error: --native-loader requested but the native library "
+                "is unavailable (see consensusml_tpu.native)",
+                file=sys.stderr,
+            )
+            return 2
+        batch_source = bundle.native_batches
     batch_shardings = None
-    for i, batch in enumerate(bundle.batches(args.rounds, args.seed, start)):
+    for i, batch in enumerate(batch_source(args.rounds, args.seed, start)):
         rnd = start + i
         if multiproc:
             # shardings depend only on the (fixed) batch structure —
