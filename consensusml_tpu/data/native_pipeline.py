@@ -68,9 +68,8 @@ def native_round_batches(
         depth=depth,
         nthreads=nthreads,
         seed=seed,
+        start_seq=start,
     ) as loader:
-        for _ in range(start):
-            loader.next()
         for _ in range(rounds):
             floats, ints = loader.next()
             yield {
@@ -115,9 +114,8 @@ def native_lm_round_batches(
         depth=depth,
         nthreads=nthreads,
         seed=seed,
+        start_seq=start,
     ) as loader:
-        for _ in range(start):
-            loader.next()
         for r in range(start, start + rounds):
             _, ints = loader.next()
             ids = ints.reshape(world_size, h, batch, dataset.seq_len)
@@ -164,9 +162,8 @@ def native_file_round_batches(
         depth=depth,
         nthreads=nthreads,
         seed=seed,
+        start_seq=start,
     ) as loader:
-        for _ in range(start):
-            loader.next()
         for _ in range(rounds):
             floats, ints = loader.next()
             yield {
@@ -210,9 +207,8 @@ def native_file_token_batches(
         depth=depth,
         nthreads=nthreads,
         seed=seed,
+        start_seq=start,
     ) as loader:
-        for _ in range(start):
-            loader.next()
         for r in range(start, start + rounds):
             _, ints = loader.next()
             ids = ints.reshape(world_size, h, batch, dataset.seq_len)

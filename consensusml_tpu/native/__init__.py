@@ -97,13 +97,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.cml_loader_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_float, _f32p, _i32p,
+        ctypes.c_float, _f32p, _i32p, ctypes.c_uint64,
     ]
     lib.cml_loader_create.restype = ctypes.c_void_p
     lib.cml_loader_create_file.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_uint64,
     ]
     lib.cml_loader_create_file.restype = ctypes.c_void_p
     lib.cml_loader_acquire.argtypes = [
@@ -219,6 +220,7 @@ class NativeLoader:
         depth: int = 4,
         nthreads: int = 2,
         seed: int = 0,
+        start_seq: int = 0,
     ):
         lib = _load()
         if lib is None:
@@ -257,7 +259,7 @@ class NativeLoader:
             self._h = lib.cml_loader_create_file(
                 depth, nthreads, seed, kinds[kind],
                 samples_per_slot, sample_floats, sample_ints, world,
-                data_p, label_p, tok_p, n_items, token_bytes,
+                data_p, label_p, tok_p, n_items, token_bytes, start_seq,
             )
             if not self._h:
                 raise RuntimeError(
@@ -281,7 +283,7 @@ class NativeLoader:
         self._h = lib.cml_loader_create(
             depth, nthreads, seed, kinds[kind],
             samples_per_slot, sample_floats, sample_ints,
-            nclasses_or_vocab, noise, proto_p, succ_p,
+            nclasses_or_vocab, noise, proto_p, succ_p, start_seq,
         )
         if not self._h:
             raise RuntimeError("cml_loader_create failed (bad arguments)")

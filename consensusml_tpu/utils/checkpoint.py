@@ -86,7 +86,10 @@ class AsyncSaver:
             except BaseException as e:  # surfaced on next submit/wait
                 self._error = e
 
-        self._thread = threading.Thread(target=write, daemon=True)
+        # NON-daemon: the interpreter joins it at shutdown, so a crash or
+        # Ctrl-C in a later round still lets the in-flight write finish —
+        # a checkpoint the log reported saved must never end up partial
+        self._thread = threading.Thread(target=write, daemon=False)
         self._thread.start()
 
     def wait(self) -> None:

@@ -63,7 +63,7 @@ class Loader {
          const int32_t* successors, int32_t world = 1,
          const float* file_data = nullptr, const int32_t* file_labels = nullptr,
          const int32_t* file_tokens = nullptr, int64_t n_items = 0,
-         int32_t token_bytes = 4)
+         int32_t token_bytes = 4, uint64_t start_seq = 0)
       : depth_(depth),
         seed_(seed),
         kind_(kind),
@@ -78,6 +78,10 @@ class Loader {
         file_tokens_(file_tokens),
         n_items_(n_items),
         token_bytes_(token_bytes) {
+    // resume: slot contents are f(seed, seq), so starting both counters at
+    // start_seq reproduces the stream from that round in O(1)
+    next_produce_ = start_seq;
+    next_consume_ = start_seq;
     if (prototypes != nullptr && kind == 0) {
       prototypes_.assign(prototypes,
                          prototypes + (int64_t)nclasses_ * sample_floats_);
@@ -260,14 +264,15 @@ void* cml_loader_create(int depth, int nthreads, uint64_t seed, int kind,
                         int64_t samples_per_slot, int64_t sample_floats,
                         int64_t sample_ints, int32_t nclasses_or_vocab,
                         float noise, const float* prototypes,
-                        const int32_t* successors) {
+                        const int32_t* successors, uint64_t start_seq) {
   if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
   if (kind != 0 && kind != 1) return nullptr;
   if (kind == 1 && (successors == nullptr || nclasses_or_vocab < 2)) return nullptr;
   if (nclasses_or_vocab < 1) return nullptr;
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, nclasses_or_vocab, noise,
-                         prototypes, successors);
+                         prototypes, successors, /*world=*/1, nullptr, nullptr,
+                         nullptr, 0, 4, start_seq);
 }
 
 // File-backed kinds (2 = classification table, 3 = token windows). The
@@ -277,7 +282,7 @@ void* cml_loader_create_file(int depth, int nthreads, uint64_t seed, int kind,
                              int64_t sample_ints, int32_t world,
                              const float* data, const int32_t* labels,
                              const int32_t* tokens, int64_t n_items,
-                             int32_t token_bytes) {
+                             int32_t token_bytes, uint64_t start_seq) {
   if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
   if (world < 1 || samples_per_slot % world != 0) return nullptr;
   if (n_items < world) return nullptr;
@@ -294,7 +299,7 @@ void* cml_loader_create_file(int depth, int nthreads, uint64_t seed, int kind,
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, /*nclasses=*/1,
                          /*noise=*/0.0f, nullptr, nullptr, world, data, labels,
-                         tokens, n_items, token_bytes);
+                         tokens, n_items, token_bytes, start_seq);
 }
 
 int cml_loader_acquire(void* h, float** fptr, int32_t** iptr) {

@@ -173,6 +173,10 @@ def test_async_saver_unit(tmp_path):
 
 def test_train_native_loader():
     """--native-loader trains end-to-end through the C++ prefetch ring."""
+    from consensusml_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not buildable here")
     r = _run(
         ["train.py", "--config", "mnist_mlp", "--device", "cpu",
          "--rounds", "3", "--native-loader"],
@@ -182,6 +186,10 @@ def test_train_native_loader():
 
 
 def test_train_native_loader_with_data_dir(tmp_path):
+    from consensusml_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not buildable here")
     from tests.test_files_data import make_mnist_dir
 
     make_mnist_dir(str(tmp_path / "m"), n_train=256)

@@ -317,3 +317,17 @@ def test_native_file_token_batches_uint16_memmap(tmp_path):
             np.asarray(x["input_ids"]), np.asarray(y["input_ids"])
         )
     assert np.asarray(a[0]["input_ids"]).dtype == np.int32
+
+
+def test_native_start_seq_resumes_stream_exactly():
+    """start=N reproduces the same batches a fresh run yields at round N
+    — in O(1), not by discarding N slots."""
+    from consensusml_tpu.data import native_round_batches
+    from consensusml_tpu.data.synthetic import SyntheticClassification
+
+    data = SyntheticClassification(n=64, image_shape=(4, 4, 1))
+    full = list(native_round_batches(data, 2, 1, 4, rounds=5, seed=3))
+    tail = list(native_round_batches(data, 2, 1, 4, rounds=2, seed=3, start=3))
+    for a, b in zip(full[3:], tail):
+        np.testing.assert_array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+        np.testing.assert_array_equal(np.asarray(a["label"]), np.asarray(b["label"]))
