@@ -80,6 +80,8 @@ _SLOW_TESTS = {
     # CHOCO contraction sweeps
     "test_choco_contracts_and_preserves_mean",
     "test_choco_collective_matches_simulated",
+    # hierarchical convergence loop
+    "test_hierarchical_with_faults_converges",
     # elastic resize (each builds + trains a stacked state first)
     "test_training_continues_after_resize_both_ways",
     "test_resize_resets_choco_state_at_new_world",
