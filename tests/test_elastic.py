@@ -156,3 +156,15 @@ def test_cli_elastic_resume(tmp_path):
     assert r3.returncode == 0, r3.stderr[-800:]
     assert "workers=6" in r3.stdout
     assert "elastic resume" not in r3.stdout
+
+
+def test_resize_resets_pushsum_mass():
+    model, cfg, state, _ = _trained_state(world=4)
+    import dataclasses
+
+    ps_cfg = dataclasses.replace(
+        _cfg(6), gossip=dataclasses.replace(_cfg(6).gossip, push_sum=True)
+    )
+    resized = resize_state(ps_cfg, state, 6, rng=jax.random.key(5))
+    assert resized.gossip is not None
+    np.testing.assert_array_equal(np.asarray(resized.gossip.w), np.ones(6))
