@@ -13,6 +13,10 @@ from consensusml_tpu.models.losses import (  # noqa: F401
     masked_lm_loss,
     softmax_cross_entropy,
 )
+from consensusml_tpu.models.fused_bn import (  # noqa: F401
+    FusedBatchNorm,
+    fused_batch_norm,
+)
 from consensusml_tpu.models.resnet import (  # noqa: F401
     ResNet,
     resnet18,

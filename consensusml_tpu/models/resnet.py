@@ -9,11 +9,16 @@ TPU-first choices:
 - NHWC layout (XLA:TPU's native conv layout — channels on the 128-lane
   minor dimension feeds the MXU directly);
 - bf16 compute / f32 BatchNorm statistics and params (MXU-native mixed
-  precision). ``norm_dtype`` selects the BN *elementwise compute* dtype;
-  flax computes the mean/var reductions in float32 regardless
-  (``force_float32_reductions``), so ``norm_dtype=bfloat16`` (the
-  default, matching ``dtype``) keeps the normalize/scale/relu chain in
-  bf16 — halving its HBM traffic — without touching statistic precision;
+  precision);
+- BatchNorm stays on the XLA path by default (``norm_impl="flax"``,
+  ``norm_dtype`` selecting the elementwise dtype; statistic reductions
+  are f32 either way). Hand-written fused Pallas BN(+ReLU) kernels
+  exist behind ``norm_impl="auto"|"pallas"``
+  (:mod:`consensusml_tpu.models.fused_bn`) but LOSE to XLA end-to-end
+  on this backend — measured isolated parity (6.5 vs 6.4 ms on a 205 MB
+  layer) and a 2x in-model regression from the layout copies the custom
+  calls force around the convs; see docs/perf.md "Fused-BN kernel
+  experiment";
 - BatchNorm running stats live in the ``batch_stats`` collection and are
   returned as ``model_state`` so the trainer gossip-averages them across
   workers along with the weights.
@@ -28,11 +33,41 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from consensusml_tpu.models.fused_bn import FusedBatchNorm
 from consensusml_tpu.models.losses import softmax_cross_entropy
 
 __all__ = ["ResNet", "resnet18", "resnet50", "resnet_loss_fn"]
 
 ModuleDef = Any
+
+
+class _FlaxNormAct(nn.Module):
+    """nn.BatchNorm + optional relu (the ``norm_impl="flax"`` path).
+
+    Note: this wrapper nests the BN one module level deeper than the
+    pre-fused-BN layout (``_FlaxNormAct_N/BatchNorm_0`` instead of
+    ``BatchNorm_N``), so ResNet checkpoints written before the fused-BN
+    change do not restore into current models (and vice versa);
+    checkpoints are versioned by code, not migrated.
+    """
+
+    use_running_average: bool = False
+    dtype: Any = jnp.bfloat16
+    act: Any = None
+    scale_init: Any = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, x):
+        if self.act not in (None, "relu"):
+            raise ValueError(f"unsupported act {self.act!r}")
+        y = nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            scale_init=self.scale_init,
+        )(x)
+        return nn.relu(y) if self.act == "relu" else y
 
 
 class BottleneckBlock(nn.Module):
@@ -41,20 +76,18 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
-    norm: ModuleDef = nn.BatchNorm
+    norm: ModuleDef = _FlaxNormAct
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(act="relu")(y)
         y = self.conv(
             self.filters, (3, 3), (self.strides, self.strides), use_bias=False, dtype=self.dtype
         )(y)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(act="relu")(y)
         y = self.conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
         # zero-init the last BN scale: residual branch starts as identity
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
@@ -76,7 +109,7 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
-    norm: ModuleDef = nn.BatchNorm
+    norm: ModuleDef = _FlaxNormAct
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -85,8 +118,7 @@ class BasicBlock(nn.Module):
         y = self.conv(
             self.filters, (3, 3), (self.strides, self.strides), use_bias=False, dtype=self.dtype
         )(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(act="relu")(y)
         y = self.conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
@@ -111,29 +143,42 @@ class ResNet(nn.Module):
     width: int = 64
     stem: str = "imagenet"  # or "cifar"
     dtype: Any = jnp.bfloat16
-    norm_dtype: Any = None  # BN elementwise dtype; None => same as dtype
+    norm_dtype: Any = None  # flax-BN elementwise dtype; None => same as dtype
+    norm_impl: str = "flax"  # flax (XLA, default) | auto|pallas|jnp (fused)
+    norm_pack_small: bool = True  # lane-pack C<128 BNs (vs XLA fallback)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, padding="SAME")
-        norm = functools.partial(
-            nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            # mean/var reductions stay float32 inside flax regardless
-            dtype=self.dtype if self.norm_dtype is None else self.norm_dtype,
-        )
+        if self.norm_impl == "flax":
+            norm = functools.partial(
+                _FlaxNormAct,
+                use_running_average=not train,
+                # mean/var reductions stay float32 inside flax regardless
+                dtype=self.dtype if self.norm_dtype is None else self.norm_dtype,
+            )
+        elif self.norm_impl in ("auto", "pallas", "jnp", "interpret"):
+            if self.norm_dtype is not None:
+                raise ValueError(
+                    "norm_dtype only applies to norm_impl='flax'; the fused "
+                    "kernels always read the input dtype with f32 arithmetic"
+                )
+            norm = functools.partial(
+                FusedBatchNorm,
+                use_running_average=not train,
+                impl=self.norm_impl,
+                pack_small=self.norm_pack_small,
+            )
+        else:
+            raise ValueError(f"unknown norm_impl {self.norm_impl!r}")
         x = jnp.asarray(x, self.dtype)
         if self.stem == "imagenet":
             x = conv(self.width, (7, 7), (2, 2), use_bias=False, dtype=self.dtype)(x)
-            x = norm()(x)
-            x = nn.relu(x)
+            x = norm(act="relu")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         elif self.stem == "cifar":
             x = conv(self.width, (3, 3), use_bias=False, dtype=self.dtype)(x)
-            x = norm()(x)
-            x = nn.relu(x)
+            x = norm(act="relu")(x)
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
         for i, n_blocks in enumerate(self.stage_sizes):
@@ -152,16 +197,18 @@ class ResNet(nn.Module):
 
 
 def resnet18(
-    num_classes: int = 10, stem: str = "cifar", dtype=jnp.bfloat16, norm_dtype=None
+    num_classes: int = 10, stem: str = "cifar", dtype=jnp.bfloat16,
+    norm_dtype=None, norm_impl: str = "flax",
 ) -> ResNet:
     return ResNet(
         stage_sizes=[2, 2, 2, 2], block=BasicBlock, num_classes=num_classes,
-        stem=stem, dtype=dtype, norm_dtype=norm_dtype,
+        stem=stem, dtype=dtype, norm_dtype=norm_dtype, norm_impl=norm_impl,
     )
 
 
 def resnet50(
-    num_classes: int = 1000, stem: str = "imagenet", dtype=jnp.bfloat16, norm_dtype=None
+    num_classes: int = 1000, stem: str = "imagenet", dtype=jnp.bfloat16,
+    norm_dtype=None, norm_impl: str = "flax", norm_pack_small: bool = True,
 ) -> ResNet:
     return ResNet(
         stage_sizes=[3, 4, 6, 3],
@@ -170,6 +217,8 @@ def resnet50(
         stem=stem,
         dtype=dtype,
         norm_dtype=norm_dtype,
+        norm_impl=norm_impl,
+        norm_pack_small=norm_pack_small,
     )
 
 

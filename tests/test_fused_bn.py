@@ -1,0 +1,198 @@
+"""Parity tests for the fused BatchNorm(+ReLU) kernels.
+
+Oracle: flax ``nn.BatchNorm`` (+ separate relu) in f32 — forward, input/
+param gradients (including gradient flow *through* the batch statistics)
+and the running-stat EMA must all match. The Pallas path runs under the
+interpreter on CPU (tests/test_fused_bn_tpu-style on-chip checks live in
+test_kernels_tpu.py).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.models.fused_bn import FusedBatchNorm, fused_batch_norm
+
+
+def _flax_ref(x, gamma, beta, relu):
+    bn = nn.BatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5, dtype=jnp.float32
+    )
+    variables = {
+        "params": {"scale": gamma, "bias": beta},
+        "batch_stats": {
+            "mean": jnp.zeros(x.shape[-1]),
+            "var": jnp.ones(x.shape[-1]),
+        },
+    }
+    y, upd = bn.apply(variables, x.astype(jnp.float32), mutable=["batch_stats"])
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y, upd["batch_stats"]
+
+
+@pytest.mark.parametrize("impl", ["jnp", "interpret"])
+@pytest.mark.parametrize(
+    "shape,relu",
+    [
+        ((16, 8, 8, 64), True),  # C < 128: lane-packing path
+        ((4, 4, 4, 256), False),  # C >= 128, no activation
+        ((512, 128), True),  # already 2-D
+    ],
+)
+def test_forward_and_grads_match_flax(impl, shape, relu):
+    rng = np.random.default_rng(0)
+    c = shape[-1]
+    x = jnp.asarray(rng.normal(size=shape) * 2 + 0.3, jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(c,)) * 0.5 + 1.0, jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(c,)) * 0.1, jnp.float32)
+    act = "relu" if relu else None
+
+    def loss(fn):
+        def f(x, gamma, beta):
+            y = fn(x, gamma, beta)
+            return jnp.sum(jnp.sin(y)), y
+
+        return jax.value_and_grad(f, argnums=(0, 1, 2), has_aux=True)
+
+    (l0, y0), g0 = loss(lambda *a: _flax_ref(*a, relu)[0])(x, gamma, beta)
+    (l1, y1), g1 = loss(
+        lambda *a: fused_batch_norm(*a, act=act, impl=impl)[0]
+    )(x, gamma, beta)
+    np.testing.assert_allclose(y1, y0, atol=1e-5)
+    np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(g1[0], g0[0], atol=1e-5)
+    for a, b in zip(g1[1:], g0[1:]):  # param grads: large f32 sums
+        np.testing.assert_allclose(a, b, rtol=3e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "interpret"])
+def test_bf16_activations_f32_stats(impl):
+    """bf16 inputs: statistics and grads accumulate in f32 (compare to an
+    f32 flax reference at bf16 tolerances)."""
+    rng = np.random.default_rng(1)
+    x32 = rng.normal(size=(32, 4, 4, 128)).astype(np.float32)
+    x = jnp.asarray(x32, jnp.bfloat16)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.float32)
+    y_ref, _ = _flax_ref(jnp.asarray(x, jnp.float32), gamma, beta, True)
+    y, mean, var = fused_batch_norm(x, gamma, beta, act="relu", impl=impl)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(y_ref), atol=0.05
+    )
+    # stats from the bf16 tensor itself, accumulated in f32
+    xf = np.asarray(x, np.float32).reshape(-1, 128)
+    np.testing.assert_allclose(mean, xf.mean(0), atol=1e-3)
+    np.testing.assert_allclose(var, xf.var(0), rtol=2e-2, atol=1e-3)
+
+
+def test_module_matches_flax_running_stats():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 6, 6, 64)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(64,)) * 0.3 + 1.0, jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(64,)) * 0.2, jnp.float32)
+    y_ref, stats_ref = _flax_ref(x, gamma, beta, True)
+    m = FusedBatchNorm(act="relu", impl="jnp")
+    variables = m.init(jax.random.key(0), x)
+    variables = {
+        "params": {"scale": gamma, "bias": beta},
+        "batch_stats": variables["batch_stats"],
+    }
+    y, upd = m.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(y, y_ref, atol=1e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            upd["batch_stats"][k], stats_ref[k], atol=1e-5
+        )
+
+
+def test_eval_mode_uses_running_stats():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 5, 5, 32)), jnp.float32)
+    mean = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+    var = jnp.asarray(rng.uniform(0.5, 2.0, size=(32,)), jnp.float32)
+    m = FusedBatchNorm(use_running_average=True, act=None, impl="jnp")
+    variables = {
+        "params": {"scale": jnp.ones(32), "bias": jnp.zeros(32)},
+        "batch_stats": {"mean": mean, "var": var},
+    }
+    y = m.apply(variables, x)
+    ref = (x - mean) / jnp.sqrt(var + 1e-5)
+    np.testing.assert_allclose(y, ref, atol=1e-5)
+
+
+def test_odd_shapes_fall_back_to_jnp():
+    """Shapes the kernel grid can't tile (C=3, M odd) still work via the
+    jnp path under impl='auto'/'pallas'."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(7, 3, 3, 3)), jnp.float32)
+    gamma, beta = jnp.ones((3,)), jnp.zeros((3,))
+    y, mean, var = fused_batch_norm(x, gamma, beta, act="relu", impl="pallas")
+    y_ref, _ = _flax_ref(x, gamma, beta, True)
+    np.testing.assert_allclose(y, y_ref, atol=1e-5)
+
+
+def test_vmap_over_workers():
+    """The stacked-worker (vmap) trainer path batches the kernels."""
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.normal(size=(4, 16, 4, 4, 64)), jnp.float32)
+    gammas = jnp.asarray(rng.normal(size=(4, 64)) * 0.2 + 1.0, jnp.float32)
+    betas = jnp.zeros((4, 64), jnp.float32)
+
+    def one(x, g, b):
+        y, mean, var = fused_batch_norm(x, g, b, act="relu", impl="interpret")
+        return y, mean
+
+    ys, means = jax.vmap(one)(xs, gammas, betas)
+    for i in range(4):
+        y_ref, _ = _flax_ref(xs[i], gammas[i], betas[i], True)
+        np.testing.assert_allclose(ys[i], y_ref, atol=1e-5)
+
+
+def test_resnet_fused_impl_matches_flax_impl():
+    """A full ResNet-18 forward/backward agrees between norm_impl='flax'
+    and the fused custom-VJP path (f32, CIFAR stem)."""
+    from consensusml_tpu.models import resnet_init, resnet_loss_fn, resnet18
+
+    rng = np.random.default_rng(7)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(8,)), jnp.int32),
+    }
+    losses, grads = [], []
+    for impl in ("flax", "jnp"):
+        model = resnet18(dtype=jnp.float32, norm_impl=impl)
+        params, mstate = resnet_init(model, (1, 32, 32, 3))(jax.random.key(0))
+        loss_fn = resnet_loss_fn(model)
+        (l, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, mstate, batch, jax.random.key(1)
+        )
+        losses.append(float(l))
+        grads.append(g)
+    assert abs(losses[0] - losses[1]) < 1e-4
+    # param trees have different module names (BatchNorm vs FusedBatchNorm)
+    # but identical leaf count and matching gradient norms
+    l0 = sorted(np.linalg.norm(np.asarray(a)) for a in jax.tree.leaves(grads[0]))
+    l1 = sorted(np.linalg.norm(np.asarray(a)) for a in jax.tree.leaves(grads[1]))
+    np.testing.assert_allclose(l1, l0, rtol=1e-3, atol=1e-5)
+
+
+def test_grad_flows_through_statistics():
+    """dx must include the -mean(g) - xhat*mean(g*xhat) terms: for
+    y = BN(x) (gamma=1, beta=0, no relu), sum(dL/dx) over the batch is
+    ~0 for any dL/dy because the output is mean-centered."""
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+
+    def f(x):
+        y, _, _ = fused_batch_norm(
+            x, jnp.ones(128), jnp.zeros(128), act=None, impl="interpret"
+        )
+        return jnp.sum(y * w)
+
+    dx = jax.grad(f)(x)
+    np.testing.assert_allclose(dx.sum(axis=0), np.zeros(128), atol=1e-4)

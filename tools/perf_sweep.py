@@ -9,7 +9,10 @@ profile-style without hand-reading traces first:
   path  : sim  — the bench's make_simulated_train_step (vmap over 1 worker)
           raw  — plain jitted fwd+bwd+SGD step, no vmap/gossip wrapper
   batch : images per step
-  bn    : f32 | bf16 BatchNorm elementwise dtype (ResNet.norm_dtype)
+  bn    : f32 | bf16 — flax BatchNorm at that elementwise dtype
+          fused      — the Pallas fused BN(+ReLU) kernels (norm_impl auto)
+          fusedw     — fused kernels only where C>=128 (XLA-preferred
+                       layouts; C<128 layers stay on the XLA path)
 
 Usage:  python tools/perf_sweep.py sim:128:f32 raw:256:bf16 ...
 Each spec runs in a fresh subprocess (clean XLA client, honest compile).
@@ -46,6 +49,8 @@ def run_variant(path: str, batch: int, bn: str, steps: int, image: int) -> dict:
         stem="imagenet",
         dtype=jnp.bfloat16,
         norm_dtype=jnp.float32 if bn == "f32" else None,
+        norm_impl="auto" if bn in ("fused", "fusedw") else "flax",
+        norm_pack_small=bn != "fusedw",
     )
     rng = np.random.default_rng(0)
     images = jnp.asarray(
