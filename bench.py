@@ -190,6 +190,73 @@ def _attention_bench() -> dict:
     return out
 
 
+def _gpt2_bench() -> dict:
+    """Model-level LM throughput at the config-5 workload shape:
+    GPT-2-medium, batch 4 x seq 1024, AdamW, full fwd+bwd+update (the
+    flash-attention dispatch is on by default for this shape)."""
+    import functools
+
+    import jax
+
+    if os.environ.get("BENCH_DEVICE"):
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
+
+    if jax.default_backend() in ("tpu", "axon"):
+        model = GPT2LM(config=GPT2Config())  # gpt2-medium dims
+        b, s, steps, label = 4, 1024, 10, "gpt2-medium"
+    else:  # CPU hosts: medium would burn the subprocess timeout for nothing
+        model = GPT2LM(
+            config=GPT2Config(
+                vocab_size=1024, hidden=128, layers=4, heads=4, max_len=256
+            )
+        )
+        b, s, steps, label = 4, 256, 10, "gpt2-smoke (cpu)"
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, model.config.vocab_size, size=(b, s)), jnp.int32
+        )
+    }
+    loss_fn = gpt2_loss_fn(model)
+    tx = optax.adamw(2e-4)
+    params = model.init(jax.random.key(0), batch["input_ids"][:1])["params"]
+    carry0 = (params, tx.init(params), jax.random.key(1))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(carry):
+        def body(c, _):
+            params, opt_state, key = c
+            key, sub = jax.random.split(key)
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, {}, batch, sub
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state, key), loss
+
+        return jax.lax.scan(body, carry, None, length=steps)
+
+    carry, losses = multi(carry0)
+    float(losses[-1])  # fence: compile + first run
+    t0 = time.time()
+    carry, losses = multi(carry)
+    final = float(losses[-1])
+    dt = time.time() - t0
+    return {
+        "model": label,
+        "batch": b,
+        "seq": s,
+        "platform": jax.default_backend(),
+        "tokens_sec": round(b * s * steps / dt, 1),
+        "step_ms": round(1000 * dt / steps, 2),
+        "loss": round(final, 3),
+    }
+
+
 def _consensus_bench() -> dict:
     """The consensus-error half of the headline metric: ~20 rounds of the
     8-worker ring on this process's devices (the driver subprocess forces
@@ -257,6 +324,9 @@ def main() -> None:
     if "--_attention" in sys.argv:
         print("INNER_RESULT " + json.dumps(_attention_bench()), flush=True)
         return
+    if "--_gpt2" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_gpt2_bench()), flush=True)
+        return
     if "--_consensus" in sys.argv:
         print("INNER_RESULT " + json.dumps(_consensus_bench()), flush=True)
         return
@@ -322,6 +392,10 @@ def main() -> None:
         extras["attention"] = run_sub("--_attention", 900)
     except (subprocess.TimeoutExpired, RuntimeError) as e:
         extras["attention"] = {"error": str(e)[:300]}
+    try:
+        extras["gpt2"] = run_sub("--_gpt2", 900)
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        extras["gpt2"] = {"error": str(e)[:300]}
 
     print(
         json.dumps(

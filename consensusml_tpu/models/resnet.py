@@ -41,33 +41,32 @@ __all__ = ["ResNet", "resnet18", "resnet50", "resnet_loss_fn"]
 ModuleDef = Any
 
 
-class _FlaxNormAct(nn.Module):
-    """nn.BatchNorm + optional relu (the ``norm_impl="flax"`` path).
+def _flax_norm_act(use_running_average: bool, dtype: Any):
+    """``norm_impl="flax"`` factory: BN + optional relu, applied inline.
 
-    Note: this wrapper nests the BN one module level deeper than the
-    pre-fused-BN layout (``_FlaxNormAct_N/BatchNorm_0`` instead of
-    ``BatchNorm_N``), so ResNet checkpoints written before the fused-BN
-    change do not restore into current models (and vice versa);
-    checkpoints are versioned by code, not migrated.
+    The ``nn.BatchNorm`` is created inside the CALLER's compact scope, so
+    params keep the pre-fused-BN names (``BatchNorm_N`` at block level) —
+    flax-path checkpoints stay compatible across the fused-BN change. The
+    fused path (``FusedBatchNorm_N``) necessarily names them differently.
     """
 
-    use_running_average: bool = False
-    dtype: Any = jnp.bfloat16
-    act: Any = None
-    scale_init: Any = nn.initializers.ones_init()
+    def make(act: Any = None, scale_init: Any = nn.initializers.ones_init()):
+        if act not in (None, "relu"):
+            raise ValueError(f"unsupported act {act!r}")
 
-    @nn.compact
-    def __call__(self, x):
-        if self.act not in (None, "relu"):
-            raise ValueError(f"unsupported act {self.act!r}")
-        y = nn.BatchNorm(
-            use_running_average=self.use_running_average,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            scale_init=self.scale_init,
-        )(x)
-        return nn.relu(y) if self.act == "relu" else y
+        def apply(x):
+            y = nn.BatchNorm(
+                use_running_average=use_running_average,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=dtype,
+                scale_init=scale_init,
+            )(x)
+            return nn.relu(y) if act == "relu" else y
+
+        return apply
+
+    return make
 
 
 class BottleneckBlock(nn.Module):
@@ -76,7 +75,7 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
-    norm: ModuleDef = _FlaxNormAct
+    norm: Any = None  # factory/Module partial: norm(act=..., scale_init=...)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -109,7 +108,7 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
-    norm: ModuleDef = _FlaxNormAct
+    norm: Any = None  # factory/Module partial: norm(act=..., scale_init=...)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -151,11 +150,10 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, padding="SAME")
         if self.norm_impl == "flax":
-            norm = functools.partial(
-                _FlaxNormAct,
-                use_running_average=not train,
-                # mean/var reductions stay float32 inside flax regardless
-                dtype=self.dtype if self.norm_dtype is None else self.norm_dtype,
+            # mean/var reductions stay float32 inside flax regardless
+            norm = _flax_norm_act(
+                not train,
+                self.dtype if self.norm_dtype is None else self.norm_dtype,
             )
         elif self.norm_impl in ("auto", "pallas", "jnp", "interpret"):
             if self.norm_dtype is not None:

@@ -20,6 +20,10 @@ from consensusml_tpu.train.local_sgd import (  # noqa: F401
     init_state,
     init_stacked_state,
 )
+from consensusml_tpu.train.schedules import (  # noqa: F401
+    build_optimizer,
+    lr_schedule,
+)
 from consensusml_tpu.train.outer import (  # noqa: F401
     SlowMoConfig,
     slowmo_init,

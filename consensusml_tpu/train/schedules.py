@@ -1,0 +1,92 @@
+"""Learning-rate schedules and optimizer rebuilding for the CLI.
+
+Reference parity: a training framework's config system exposes LR /
+schedule / clipping knobs (SURVEY.md L6 config system; the mount is
+empty, so the flag surface follows standard practice: constant / cosine
+/ linear-decay schedules with linear warmup, global-norm clipping).
+
+Schedules are expressed in OPTIMIZER STEPS. One gossip round runs ``h``
+local steps, so the CLI converts ``--warmup-rounds``/``--rounds`` to
+steps before calling :func:`lr_schedule`. The step count lives in the
+optimizer state, which is checkpointed — ``--resume`` continues the
+schedule exactly where it left off.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import optax
+
+__all__ = ["lr_schedule", "build_optimizer"]
+
+ScheduleOrFloat = Union[float, Callable[[int], float]]
+
+
+def lr_schedule(
+    kind: str, peak: float, total_steps: int, warmup_steps: int = 0
+) -> ScheduleOrFloat:
+    """``constant`` | ``cosine`` | ``linear`` with ``warmup_steps`` of
+    linear warmup from 0. Returns a plain float for the no-op case so the
+    optimizer state stays schedule-free when nothing was requested."""
+    if kind == "constant":
+        if warmup_steps <= 0:
+            return peak
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak, warmup_steps),
+                optax.constant_schedule(peak),
+            ],
+            [warmup_steps],
+        )
+    if warmup_steps >= total_steps:
+        raise ValueError(
+            f"warmup ({warmup_steps} steps) must be shorter than the "
+            f"schedule ({total_steps} steps) for kind={kind!r}"
+        )
+    decay_steps = total_steps - warmup_steps
+    if kind == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=peak,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+            end_value=0.0,
+        )
+    if kind == "linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak, max(warmup_steps, 1)),
+                optax.linear_schedule(peak, 0.0, decay_steps),
+            ],
+            [warmup_steps],
+        )
+    raise ValueError(f"unknown lr schedule {kind!r}")
+
+
+def build_optimizer(
+    factory: Callable[..., optax.GradientTransformation],
+    *,
+    peak_lr: float,
+    kind: str = "constant",
+    total_steps: int = 0,
+    warmup_steps: int = 0,
+    grad_clip: float = 0.0,
+) -> optax.GradientTransformation:
+    """Rebuild a config's optimizer with a schedule and optional
+    global-norm clipping (clip runs BEFORE the optimizer, the standard
+    order).
+
+    A factory that accepts ``grad_clip`` places the clip itself —
+    required when the optimizer masks parameters (LoRA: the global norm
+    must be over the *trained* subtree, not the frozen base weights).
+    Plain factories (e.g. ``optax.sgd``) get the clip chained outside.
+    """
+    sched = lr_schedule(kind, peak_lr, total_steps, warmup_steps)
+    try:
+        return factory(sched, grad_clip=grad_clip)
+    except TypeError:
+        tx = factory(sched)
+        if grad_clip > 0:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        return tx

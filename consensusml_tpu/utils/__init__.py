@@ -2,7 +2,9 @@
 
 from consensusml_tpu.utils.checkpoint import (  # noqa: F401
     AsyncSaver,
+    checkpoint_round,
     checkpoint_world_size,
+    replicated_scalar,
     restore_state,
     save_state,
 )

@@ -21,6 +21,8 @@ __all__ = [
     "save_state",
     "restore_state",
     "checkpoint_world_size",
+    "checkpoint_round",
+    "replicated_scalar",
     "AsyncSaver",
 ]
 
@@ -46,9 +48,27 @@ def save_state(path: str, state: Any, step: int | None = None) -> str:
         meta = os.path.join(path, "cml_meta.json")
         tmp = meta + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"world_size": int(step_leaf.shape[0])}, f)
+            json.dump(
+                {
+                    "world_size": int(step_leaf.shape[0]),
+                    "round": replicated_scalar(step_leaf),
+                },
+                f,
+            )
         os.replace(tmp, meta)
     return path
+
+
+def replicated_scalar(leaf) -> int:
+    """First element of a replicated per-worker counter (e.g.
+    ``TrainState.step``), fetched through ONE addressable shard —
+    ``device_get`` of the whole leaf fails on arrays sharded across
+    processes (two-controller runs)."""
+    if hasattr(leaf, "addressable_shards"):
+        leaf = leaf.addressable_shards[0].data
+    import numpy as np
+
+    return int(np.asarray(jax.device_get(leaf)).ravel()[0])
 
 
 class AsyncSaver:
@@ -108,6 +128,18 @@ def checkpoint_world_size(path: str) -> int | None:
     try:
         with open(meta) as f:
             return int(json.load(f)["world_size"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def checkpoint_round(path: str) -> int | None:
+    """Gossip round recorded at save time, or None (older checkpoints
+    predate the record). Lets the CLI extend an LR schedule across
+    ``--resume`` without restoring the state first."""
+    meta = os.path.join(os.path.abspath(path), "cml_meta.json")
+    try:
+        with open(meta) as f:
+            return int(json.load(f)["round"])
     except (OSError, ValueError, KeyError, TypeError):
         return None
 

@@ -60,6 +60,8 @@ _SLOW_TESTS = {
     "test_train_native_loader",
     "test_train_native_loader_with_data_dir",
     "test_train_topology_override_bad_name",
+    "test_train_lr_schedule_flags",
+    "test_lora_grad_clip_ignores_frozen_base",
     # time-varying topology convergence
     "test_onepeer_beats_ring_consensus_decay",
     "test_choco_collective_matches_simulated_onepeer",

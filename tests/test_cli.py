@@ -199,3 +199,20 @@ def test_train_native_loader_with_data_dir(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-800:]
     assert "final:" in r.stdout
+
+
+def test_train_lr_schedule_flags(tmp_path):
+    """--lr/--lr-schedule/--warmup-rounds/--grad-clip rebuild the config
+    optimizer and still train (loss must improve under warmup+cosine)."""
+    metrics = tmp_path / "m.jsonl"
+    r = _run(
+        [
+            "train.py", "--config", "mnist_mlp", "--device", "cpu",
+            "--rounds", "6", "--lr", "2e-3", "--lr-schedule", "cosine",
+            "--warmup-rounds", "2", "--grad-clip", "1.0",
+            "--metrics-out", str(metrics),
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert lines[-1]["loss"] < lines[0]["loss"]

@@ -134,3 +134,60 @@ def test_flash_attention_on_tpu():
         pytest.skip(result["skip"])
     assert result["fwd_max_err"] < 0.02, result  # bf16-precision matmuls
     assert result["dq_rel_err"] < 0.02, result
+
+
+_FUSED_BN_CHILD = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() not in ("tpu", "axon"):
+    print(json.dumps({"skip": f"no TPU (backend={jax.default_backend()})"}))
+    raise SystemExit(0)
+
+from consensusml_tpu.models.fused_bn import fused_batch_norm
+
+out = {"backend": jax.default_backend()}
+rng = np.random.default_rng(0)
+errs = {}
+for name, (m, c) in {"wide": (4096, 256), "packed": (4096, 64)}.items():
+    x = jnp.asarray(rng.normal(size=(m, c)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=(c,)) * 0.3 + 1.0, jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(c,)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(c,)), jnp.float32)
+
+    def loss(x, gamma, beta, impl):
+        y, mean, var = fused_batch_norm(x, gamma, beta, act="relu", impl=impl)
+        return jnp.sum(jnp.sin(y) * w)
+
+    vg = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)), static_argnums=3)
+    l_p, g_p = vg(x, gamma, beta, "pallas")
+    l_j, g_j = vg(x, gamma, beta, "jnp")
+    errs[name] = {
+        "loss": abs(float(l_p - l_j)),
+        "dx": float(jnp.max(jnp.abs(g_p[0] - g_j[0]))),
+        "dgamma": float(jnp.max(jnp.abs(g_p[1] - g_j[1]))),
+        "dbeta": float(jnp.max(jnp.abs(g_p[2] - g_j[2]))),
+    }
+out["errs"] = errs
+print(json.dumps(out))
+"""
+
+
+def test_fused_bn_on_tpu():
+    """The compiled fused-BN kernels match the jnp custom-VJP math on the
+    chip (wide C>=128 and lane-packed C<128 variants, fwd + all grads)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _FUSED_BN_CHILD],
+        capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    result = json.loads(line)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    for name, e in result["errs"].items():
+        assert e["loss"] < 1e-2 and e["dx"] < 1e-4, (name, e)
+        assert e["dgamma"] < 1e-2 and e["dbeta"] < 1e-2, (name, e)

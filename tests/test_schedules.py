@@ -1,0 +1,160 @@
+"""LR schedules / optimizer rebuilding (train.schedules + CLI flags)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.train import build_optimizer, lr_schedule
+
+
+def _vals(s, steps):
+    if callable(s):
+        return [float(s(t)) for t in range(steps)]
+    return [float(s)] * steps
+
+
+def test_constant_without_warmup_is_a_float():
+    assert lr_schedule("constant", 0.1, 100) == 0.1
+
+
+def test_constant_with_warmup():
+    s = lr_schedule("constant", 0.1, 100, warmup_steps=10)
+    v = _vals(s, 100)
+    assert v[0] == 0.0
+    np.testing.assert_allclose(v[5], 0.05, atol=1e-6)
+    assert all(abs(x - 0.1) < 1e-6 for x in v[10:])
+
+
+def test_cosine_warmup_peak_decay():
+    s = lr_schedule("cosine", 1.0, 100, warmup_steps=20)
+    v = _vals(s, 101)
+    assert v[0] == 0.0
+    np.testing.assert_allclose(v[20], 1.0, atol=1e-6)
+    assert v[60] < v[20] and v[99] < 0.01
+
+
+def test_linear_decays_to_zero():
+    s = lr_schedule("linear", 0.5, 100, warmup_steps=10)
+    v = _vals(s, 101)
+    np.testing.assert_allclose(v[10], 0.5, atol=1e-6)
+    assert v[100] < 1e-6
+    # monotone decay after warmup
+    assert all(a >= b - 1e-9 for a, b in zip(v[10:-1], v[11:]))
+
+
+def test_unknown_kind_raises():
+    with pytest.raises(ValueError):
+        lr_schedule("exponential", 0.1, 100)
+
+
+def test_build_optimizer_clips_global_norm():
+    tx = build_optimizer(
+        optax.sgd, peak_lr=1.0, total_steps=10, grad_clip=1.0
+    )
+    params = {"w": jnp.zeros(4)}
+    state = tx.init(params)
+    grads = {"w": jnp.full(4, 100.0)}
+    updates, _ = tx.update(grads, state, params)
+    # global norm clipped to 1 then scaled by lr=1 (sgd negates)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(updates["w"])), 1.0, rtol=1e-5
+    )
+
+
+def test_build_optimizer_schedule_reaches_optimizer():
+    tx = build_optimizer(
+        optax.sgd, peak_lr=1.0, kind="linear", total_steps=4, warmup_steps=0
+    )
+    params = {"w": jnp.ones(2)}
+    state = tx.init(params)
+    grads = {"w": jnp.ones(2)}
+    norms = []
+    for _ in range(4):
+        updates, state = tx.update(grads, state, params)
+        norms.append(float(jnp.abs(updates["w"][0])))
+    assert norms[0] > norms[1] > norms[2] > norms[3]
+
+
+def test_all_configs_expose_optimizer_factory():
+    from consensusml_tpu import configs
+
+    for name in configs.names():
+        b = configs.build(name, "smoke")
+        assert b.optimizer_factory is not None, name
+        assert b.base_lr is not None, name
+        tx = build_optimizer(
+            b.optimizer_factory,
+            peak_lr=b.base_lr,
+            kind="cosine",
+            total_steps=20,
+            warmup_steps=4,
+            grad_clip=1.0,
+        )
+        assert isinstance(tx, optax.GradientTransformation), name
+
+
+def test_checkpoint_round_roundtrip(tmp_path):
+    """save_state records the gossip round; checkpoint_round reads it
+    back without restoring (the CLI uses it to extend LR schedules
+    across --resume)."""
+    import jax
+
+    from consensusml_tpu.train.local_sgd import TrainState
+    from consensusml_tpu.utils import (
+        checkpoint_round,
+        checkpoint_world_size,
+        save_state,
+    )
+
+    state = TrainState(
+        step=jnp.full((4,), 17, jnp.int32),
+        params={"w": jnp.ones((4, 3))},
+        model_state={},
+        opt_state=(),
+        rng=jax.random.split(jax.random.key(0), 4),
+        gossip={},
+    )
+    path = save_state(str(tmp_path / "ck"), state, step=17)
+    assert checkpoint_round(path) == 17
+    assert checkpoint_world_size(path) == 4
+    assert checkpoint_round(str(tmp_path / "missing")) is None
+
+
+def test_lora_grad_clip_ignores_frozen_base():
+    """--grad-clip on llama_lora must clip by the ADAPTER gradient norm:
+    huge gradients on the frozen base weights may not scale the adapter
+    update down."""
+    import jax
+
+    from consensusml_tpu import configs
+
+    b = configs.build("llama_lora", "smoke")
+    tx = build_optimizer(b.optimizer_factory, peak_lr=1.0, grad_clip=1.0)
+    params = b.init_params(jax.random.key(0))
+    state = tx.init(params)
+    is_lora = lambda path: any("lora" in str(k).lower() for k in path)
+    # tiny adapter grads (well under the clip), enormous base grads
+    grads = jax.tree_util.tree_map_with_path(
+        lambda path, p: jnp.full_like(p, 1e-3 if is_lora(path) else 1e6),
+        params,
+    )
+    updates, _ = tx.update(grads, state, params)
+    leaves = jax.tree_util.tree_leaves_with_path(updates)
+    lora_norms = [
+        float(jnp.max(jnp.abs(v))) for path, v in leaves if is_lora(path)
+    ]
+    frozen_norms = [
+        float(jnp.max(jnp.abs(v))) for path, v in leaves if not is_lora(path)
+    ]
+    assert lora_norms and max(frozen_norms) == 0.0
+    # un-over-clipped: adam with unclipped tiny grads moves ~lr; if the
+    # frozen base norm (1e6-scale) drove the clip, this would be ~1e-9
+    assert max(lora_norms) > 1e-3
+
+
+def test_warmup_longer_than_schedule_raises():
+    with pytest.raises(ValueError, match="warmup"):
+        lr_schedule("cosine", 0.1, 10, warmup_steps=10)
+    with pytest.raises(ValueError, match="warmup"):
+        lr_schedule("linear", 0.1, 10, warmup_steps=12)

@@ -70,6 +70,15 @@ def parse_args(argv=None):
                    help="train on real files from this directory (MNIST idx / "
                         "CIFAR-10 binaries / tokens.bin — see data.files); "
                         "falls back to procedural data when absent")
+    p.add_argument("--lr", type=float, default=None,
+                   help="override the config's peak learning rate")
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["constant", "cosine", "linear"],
+                   help="LR schedule over --rounds (steps = rounds x h)")
+    p.add_argument("--warmup-rounds", type=int, default=0,
+                   help="linear LR warmup, in gossip rounds")
+    p.add_argument("--grad-clip", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -83,6 +92,29 @@ def parse_args(argv=None):
     p.add_argument("--resume", default=None, help="checkpoint path to resume from")
     p.add_argument("--list", action="store_true", help="list configs and exit")
     return p.parse_args(argv)
+
+
+def _try_restore(path: str, template, lr_flags: bool):
+    """restore_state with a clean CLI diagnostic instead of a raw orbax
+    traceback. Returns the restored state, or None (caller exits 2)."""
+    from consensusml_tpu.utils import restore_state
+
+    try:
+        return restore_state(path, template)
+    except Exception as e:
+        hint = (
+            " (hint: --lr-schedule/--grad-clip change the optimizer state "
+            "structure; resume with the SAME LR flags the checkpoint was "
+            "trained with)"
+            if lr_flags
+            else ""
+        )
+        print(
+            f"error: cannot restore {path}: "
+            f"{type(e).__name__}: {str(e)[:400]}{hint}",
+            file=sys.stderr,
+        )
+        return None
 
 
 def main(argv=None) -> int:
@@ -112,7 +144,7 @@ def main(argv=None) -> int:
         make_collective_train_step,
         make_simulated_train_step,
     )
-    from consensusml_tpu.utils import MetricsLogger, restore_state
+    from consensusml_tpu.utils import MetricsLogger
 
     if args.list:
         for name in configs.names():
@@ -147,6 +179,55 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    lr_flags = (
+        args.lr is not None
+        or args.lr_schedule is not None
+        or args.warmup_rounds > 0
+        or args.grad_clip > 0
+    )
+    if lr_flags:
+        import dataclasses
+
+        from consensusml_tpu.train.schedules import build_optimizer
+
+        if bundle.optimizer_factory is None:
+            print(
+                f"error: config {args.config} has no optimizer factory; "
+                "LR/clip flags are unavailable",
+                file=sys.stderr,
+            )
+            return 2
+        # schedules are in absolute optimizer steps and the checkpointed
+        # step count is absolute too, so a resumed run must size the
+        # schedule over (already-trained + requested) rounds or it would
+        # spend the whole second leg at the schedule's end value
+        sched_start = 0
+        if args.resume:
+            from consensusml_tpu.utils import checkpoint_round
+
+            ckpt_round = checkpoint_round(args.resume)
+            sched_start = ckpt_round or 0
+            if ckpt_round is None and args.lr_schedule:
+                print(
+                    "warning: checkpoint has no round record (pre-round "
+                    "meta); the LR schedule is sized over this run's "
+                    "--rounds only",
+                    file=sys.stderr,
+                )
+        try:
+            tx = build_optimizer(
+                bundle.optimizer_factory,
+                peak_lr=args.lr if args.lr is not None else bundle.base_lr,
+                kind=args.lr_schedule or "constant",
+                total_steps=(sched_start + args.rounds) * bundle.cfg.h,
+                warmup_steps=args.warmup_rounds * bundle.cfg.h,
+                grad_clip=args.grad_clip,
+            )
+        except ValueError as e:  # e.g. --warmup-rounds >= --rounds
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        bundle.cfg = dataclasses.replace(bundle.cfg, optimizer=tx)
 
     if args.topology is not None:
         import dataclasses
@@ -333,7 +414,9 @@ def main(argv=None) -> int:
                 bundle.cfg, bundle.init_params, jax.random.key(args.seed),
                 elastic_from,
             )
-            restored = restore_state(args.resume, old_template)
+            restored = _try_restore(args.resume, old_template, lr_flags)
+            if restored is None:
+                return 2
             resized = resize_state(
                 bundle.cfg, restored, bundle.world_size,
                 rng=jax.random.key(args.seed + 1),
@@ -352,17 +435,14 @@ def main(argv=None) -> int:
             )
         )
         if args.resume:
-            state = restore_state(args.resume, state)
+            restored = _try_restore(args.resume, state, lr_flags)
+            if restored is None:
+                return 2
+            state = restored
     if args.resume:
-        import numpy as np
+        from consensusml_tpu.utils import replicated_scalar
 
-        # per-worker step counters are identical, so ONE addressable shard
-        # suffices (device_get of the whole array would fail on a state
-        # sharded across processes)
-        leaf = state.step
-        if hasattr(leaf, "addressable_shards"):
-            leaf = leaf.addressable_shards[0].data
-        start = int(np.asarray(jax.device_get(leaf)).ravel()[0])
+        start = replicated_scalar(state.step)
         print(f"resumed from {args.resume} at round {start}", flush=True)
 
     from consensusml_tpu.utils import RoundTimer, trace as profile_trace
