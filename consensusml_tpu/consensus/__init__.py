@@ -12,6 +12,7 @@ special case.
 
 from consensusml_tpu.consensus.engine import (  # noqa: F401
     ChocoState,
+    OverlapState,
     ConsensusEngine,
     GossipConfig,
 )

@@ -38,7 +38,7 @@ from consensusml_tpu.consensus.pushsum import (
 )
 from consensusml_tpu.topology import Topology
 
-__all__ = ["GossipConfig", "ChocoState", "ConsensusEngine"]
+__all__ = ["GossipConfig", "ChocoState", "OverlapState", "ConsensusEngine"]
 
 
 class ChocoState(NamedTuple):
@@ -46,6 +46,14 @@ class ChocoState(NamedTuple):
 
     xhat: Any  # my public (compression-tracked) copy of my params
     s: Any  # running sum_j W[i,j] xhat_j
+
+
+class OverlapState(NamedTuple):
+    """Overlap-gossip carry: the consensus correction ``(W - I) z`` computed
+    from this round's PRE-inner-loop params, applied at the start of the
+    next round (see ``GossipConfig.overlap``)."""
+
+    correction: Any  # params-shaped
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,8 +71,37 @@ class GossipConfig:
     path_filter: Any = None  # Callable[[tuple], bool] | None
     faults: FaultConfig | None = None  # None => no fault model
     push_sum: bool = False  # ratio consensus (see consensus.pushsum)
+    # Overlap gossip (combine-then-adapt): the round becomes
+    #   z_{k+1} = z_k + u_k + (W - I) z_k        (u_k = inner-loop updates)
+    # i.e. the mixing correction is computed from the PRE-inner params and
+    # applied one round late. The correction's ppermutes depend only on
+    # z_k — not on the inner loop — so XLA's latency-hiding scheduler can
+    # run the communication UNDER the H local steps (the point: comm cost
+    # vanishes on slow links/DCN). Mean-exact (sum_i correction_i = 0 for
+    # doubly stochastic W); this is the classic CTA diffusion recurrence
+    # x <- W x - lr g(x) (Sayed, "Adaptation, Learning, and Optimization
+    # over Networks", 2014), so standard convergence results apply.
+    overlap: bool = False
 
     def __post_init__(self):
+        if self.overlap and self.compressor is not None:
+            raise NotImplementedError(
+                "overlap + compression is not supported: CHOCO's innovation "
+                "tracking is defined against the same-round mixing update, "
+                "not the one-round-delayed correction"
+            )
+        if self.overlap and self.push_sum:
+            raise NotImplementedError(
+                "overlap + push-sum is not supported: the mass ratio must "
+                "be updated with the same W application as the numerator, "
+                "which the delayed correction splits across rounds"
+            )
+        if self.overlap and self.faults is not None:
+            raise NotImplementedError(
+                "overlap + fault injection is not supported yet: a dropped "
+                "round would apply a correction computed against a W the "
+                "peer never participated in"
+            )
         if self.compressor is not None and self.faults is not None:
             raise NotImplementedError(
                 "fault-tolerant COMPRESSED gossip is not supported yet: "
@@ -136,6 +173,13 @@ class ConsensusEngine:
         """
         if self.config.push_sum:
             return pushsum_init(world_size)
+        if self.config.overlap:
+            sel = params
+            if self.config.path_filter is not None:
+                sel, _ = self._select(params)
+            return OverlapState(
+                correction=jax.tree.map(jnp.zeros_like, sel)
+            )
         if not self.compressed:
             return None
         if self.config.path_filter is not None:
@@ -252,6 +296,63 @@ class ConsensusEngine:
         if rebuild is not None:
             x_new = rebuild(x_new)
         return x_new, ChocoState(xhat=xhat, s=s)
+
+    # ---- overlap gossip (combine-then-adapt) ----------------------------
+    def apply_correction(self, tree: Any, state: OverlapState) -> Any:
+        """Start-of-round combine: add last round's ``(W - I) z`` to the
+        gossiped leaves (others pass through untouched)."""
+        if self.config.path_filter is not None:
+            sel, rebuild = self._select(tree)
+            return rebuild(jax.tree.map(jnp.add, sel, state.correction))
+        return jax.tree.map(jnp.add, tree, state.correction)
+
+    def _correction(self, mix_fn, tree: Any) -> OverlapState:
+        sel = tree
+        if self.config.path_filter is not None:
+            sel, _ = self._select(tree)
+        mixed = mix_fn(sel)
+        return OverlapState(
+            correction=jax.tree.map(
+                lambda m, t: (m - t).astype(t.dtype), mixed, sel
+            )
+        )
+
+    def correction_collective(
+        self, tree: Any, step: jax.Array | None = None
+    ) -> OverlapState:
+        """Next round's correction from this round's pre-inner params.
+
+        Issued alongside (not after) the inner loop: the ppermutes here
+        depend only on ``tree``, so the scheduler overlaps them with the
+        local steps.
+        """
+        topo = self.topology
+        if not topo.is_time_varying:
+            return self._correction(
+                lambda t: collectives.mix_tree(t, topo), tree
+            )
+        if step is None:
+            raise ValueError(
+                f"{type(topo).__name__} is time-varying: "
+                "correction_collective needs the round counter (step=...)"
+            )
+        branches = [
+            functools.partial(
+                lambda phase, t: self._correction(
+                    lambda s: collectives.mix_tree(s, phase), t
+                ),
+                phase,
+            )
+            for phase in topo.phases
+        ]
+        return jax.lax.switch(step % topo.period, branches, tree)
+
+    def correction_simulated(self, tree: Any, w: jax.Array) -> OverlapState:
+        """Stacked-backend correction: ``(W - I) z`` via the mixing matrix
+        (w already phase-selected by the caller)."""
+        return self._correction(
+            lambda t: simulated.mix_tree_stacked(t, w), tree
+        )
 
     # ---- simulated backend (stacked leading worker axis) ----------------
     def round_simulated(

@@ -80,16 +80,18 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # standalone use (no norm passed): train-mode flax BN
+        norm = self.norm or _flax_norm_act(False, self.dtype)
         residual = x
         y = self.conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = self.norm(act="relu")(y)
+        y = norm(act="relu")(y)
         y = self.conv(
             self.filters, (3, 3), (self.strides, self.strides), use_bias=False, dtype=self.dtype
         )(y)
-        y = self.norm(act="relu")(y)
+        y = norm(act="relu")(y)
         y = self.conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
         # zero-init the last BN scale: residual branch starts as identity
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(
                 self.filters * 4,
@@ -98,7 +100,7 @@ class BottleneckBlock(nn.Module):
                 use_bias=False,
                 dtype=self.dtype,
             )(residual)
-            residual = self.norm()(residual)
+            residual = norm()(residual)
         return nn.relu(residual + y)
 
 
@@ -113,13 +115,15 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # standalone use (no norm passed): train-mode flax BN
+        norm = self.norm or _flax_norm_act(False, self.dtype)
         residual = x
         y = self.conv(
             self.filters, (3, 3), (self.strides, self.strides), use_bias=False, dtype=self.dtype
         )(x)
-        y = self.norm(act="relu")(y)
+        y = norm(act="relu")(y)
         y = self.conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(
                 self.filters,
@@ -128,7 +132,7 @@ class BasicBlock(nn.Module):
                 use_bias=False,
                 dtype=self.dtype,
             )(residual)
-            residual = self.norm()(residual)
+            residual = norm()(residual)
         return nn.relu(residual + y)
 
 

@@ -68,6 +68,14 @@ class LocalSGDConfig:
     h: int = 1  # local (inner) steps between gossip rounds
     outer: SlowMoConfig | None = None  # None => mixed params used as-is
 
+    def __post_init__(self):
+        if self.gossip.overlap and self.outer is not None:
+            raise NotImplementedError(
+                "overlap gossip + SlowMo is not supported: SlowMo's slow "
+                "momentum steps on the same-round mixed params, which "
+                "overlap mode never materializes"
+            )
+
     def engine(self) -> ConsensusEngine:
         return ConsensusEngine(self.gossip)
 
@@ -238,6 +246,33 @@ def make_collective_train_step(
     def sharded_round(state: TrainState, batch: Any):
         state = _squeeze(state, n_axes)
         batch = _squeeze(batch, n_axes)
+        if cfg.gossip.overlap:
+            # combine-then-adapt: apply last round's correction, then run
+            # the inner loop on z WHILE this round's correction (ppermutes
+            # on z, independent of the local steps) is in flight
+            z = engine.apply_correction(
+                _gossiped(state.params, state.model_state), state.gossip
+            )
+            gossip = engine.correction_collective(z, step=state.step)
+            params, model_state, opt_state, rng, loss = _inner_loop(
+                cfg, loss_fn, z["params"], z["model_state"], state.opt_state,
+                state.rng, batch,
+            )
+            err = engine.consensus_error_collective(params)
+            new_state = TrainState(
+                step=state.step + 1,
+                params=params,
+                model_state=model_state,
+                opt_state=opt_state,
+                gossip=gossip,
+                rng=rng,
+                outer=state.outer,
+            )
+            metrics = {
+                "loss": jax.lax.pmean(loss, topo.axis_names),
+                "consensus_error": err,
+            }
+            return _unsqueeze(new_state, n_axes), metrics
         params, model_state, opt_state, rng, loss = _inner_loop(
             cfg, loss_fn, state.params, state.model_state, state.opt_state, state.rng, batch
         )
@@ -351,6 +386,32 @@ def make_simulated_train_step(
         def worker(params, model_state, opt_state, rng, batch):
             return _inner_loop(cfg, loss_fn, params, model_state, opt_state, rng, batch)
 
+        if cfg.gossip.overlap:
+            w = (
+                w_all[state.step[0] % topo.period]
+                if topo.is_time_varying
+                else w_all
+            )
+            z = engine.apply_correction(
+                _gossiped(state.params, state.model_state), state.gossip
+            )
+            gossip = engine.correction_simulated(z, w)
+            params, model_state, opt_state, rng, losses = jax.vmap(worker)(
+                z["params"], z["model_state"], state.opt_state, state.rng, batch
+            )
+            new_state = TrainState(
+                step=state.step + 1,
+                params=params,
+                model_state=model_state,
+                opt_state=opt_state,
+                gossip=gossip,
+                rng=rng,
+                outer=state.outer,
+            )
+            return new_state, {
+                "loss": jnp.mean(losses),
+                "consensus_error": engine.consensus_error_simulated(params),
+            }
         params, model_state, opt_state, rng, losses = jax.vmap(worker)(
             state.params, state.model_state, state.opt_state, state.rng, batch
         )

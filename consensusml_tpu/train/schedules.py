@@ -29,6 +29,11 @@ def lr_schedule(
     """``constant`` | ``cosine`` | ``linear`` with ``warmup_steps`` of
     linear warmup from 0. Returns a plain float for the no-op case so the
     optimizer state stays schedule-free when nothing was requested."""
+    if warmup_steps > 0 and warmup_steps >= total_steps:
+        raise ValueError(
+            f"warmup ({warmup_steps} steps) must be shorter than the "
+            f"schedule ({total_steps} steps)"
+        )
     if kind == "constant":
         if warmup_steps <= 0:
             return peak
@@ -38,11 +43,6 @@ def lr_schedule(
                 optax.constant_schedule(peak),
             ],
             [warmup_steps],
-        )
-    if warmup_steps >= total_steps:
-        raise ValueError(
-            f"warmup ({warmup_steps} steps) must be shorter than the "
-            f"schedule ({total_steps} steps) for kind={kind!r}"
         )
     decay_steps = total_steps - warmup_steps
     if kind == "cosine":

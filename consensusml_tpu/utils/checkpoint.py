@@ -121,27 +121,27 @@ class AsyncSaver:
             raise RuntimeError(f"async checkpoint write failed: {err!r}") from err
 
 
-def checkpoint_world_size(path: str) -> int | None:
-    """World size recorded at save time, or None (pre-meta checkpoint or
+def _meta_int(path: str, key: str) -> int | None:
+    """One int field of ``cml_meta.json``, or None (pre-meta checkpoint or
     unreadable/corrupt meta — treated as absent, never raised)."""
     meta = os.path.join(os.path.abspath(path), "cml_meta.json")
     try:
         with open(meta) as f:
-            return int(json.load(f)["world_size"])
+            return int(json.load(f)[key])
     except (OSError, ValueError, KeyError, TypeError):
         return None
+
+
+def checkpoint_world_size(path: str) -> int | None:
+    """World size recorded at save time, or None when absent."""
+    return _meta_int(path, "world_size")
 
 
 def checkpoint_round(path: str) -> int | None:
     """Gossip round recorded at save time, or None (older checkpoints
     predate the record). Lets the CLI extend an LR schedule across
     ``--resume`` without restoring the state first."""
-    meta = os.path.join(os.path.abspath(path), "cml_meta.json")
-    try:
-        with open(meta) as f:
-            return int(json.load(f)["round"])
-    except (OSError, ValueError, KeyError, TypeError):
-        return None
+    return _meta_int(path, "round")
 
 
 def restore_state(path: str, like: Any) -> Any:

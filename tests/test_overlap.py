@@ -1,0 +1,173 @@
+"""Overlap (combine-then-adapt) gossip: z_{k+1} = W z_k + u_k.
+
+The correction ``(W - I) z`` is computed from pre-inner-loop params and
+applied one round late, so the communication is schedulable UNDER the H
+local steps. With zero inner updates the recurrence is plain gossip
+``z <- W z`` — that exactness anchors the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from consensusml_tpu.comm import WorkerMesh, simulated
+from consensusml_tpu.consensus import GossipConfig, OverlapState
+from consensusml_tpu.data import SyntheticClassification, round_batches
+from consensusml_tpu.models import MLP, mlp_loss_fn
+from consensusml_tpu.topology import RingTopology, TorusTopology
+from consensusml_tpu.train import (
+    LocalSGDConfig,
+    init_stacked_state,
+    make_collective_train_step,
+    make_simulated_train_step,
+)
+
+WORLD = 8
+
+
+def _cfg(topo, lr=0.05, h=1, overlap=True):
+    return LocalSGDConfig(
+        gossip=GossipConfig(topology=topo, overlap=overlap),
+        optimizer=optax.sgd(lr),
+        h=h,
+    )
+
+
+def _batches(cfg, rounds, batch=16, seed=0):
+    data = SyntheticClassification(n=256, image_shape=(8, 8, 1))
+    return round_batches(data, WORLD, cfg.h, batch, rounds, seed=seed)
+
+
+def test_zero_lr_reduces_to_plain_gossip():
+    """With no inner updates, overlap mode IS x <- W x: params match the
+    mixing-matrix power exactly and consensus error contracts at the
+    spectral rate."""
+    topo = RingTopology(WORLD)
+    cfg = _cfg(topo, lr=0.0)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(MLP(hidden=8)))
+    state = init_stacked_state(
+        cfg, lambda r: MLP(hidden=8).init(r, jnp.zeros((1, 8, 8, 1)))["params"],
+        jax.random.key(0), WORLD,
+    )
+    x0 = jax.tree.map(jnp.copy, state.params)
+    w = np.asarray(simulated.mixing_matrix(topo))
+    rounds = 6
+    for batch in _batches(cfg, rounds):
+        state, metrics = step(state, batch)
+    # after k rounds the params hold W^{k-1} x0: round k's correction is
+    # still in flight in the carry (that pipeline lag IS the overlap)
+    wk = np.linalg.matrix_power(w, rounds - 1)
+    expect = jax.tree.map(
+        lambda x: jnp.einsum("ij,j...->i...", jnp.asarray(wk), x), x0
+    )
+    for got, want in zip(jax.tree.leaves(state.params), jax.tree.leaves(expect)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_collective_matches_simulated():
+    topo = TorusTopology(2, 4)
+    cfg = _cfg(topo, lr=0.05, h=2)
+    loss_fn = mlp_loss_fn(MLP(hidden=8))
+    init = lambda r: MLP(hidden=8).init(r, jnp.zeros((1, 8, 8, 1)))["params"]
+    sim_step = make_simulated_train_step(cfg, loss_fn)
+    col_step = make_collective_train_step(
+        cfg, loss_fn, WorkerMesh.create(topo, devices=jax.devices()[:WORLD])
+    )
+    sim = init_stacked_state(cfg, init, jax.random.key(0), WORLD)
+    col = jax.tree.map(jnp.copy, sim)
+    for batch in _batches(cfg, 4):
+        sim, sm = sim_step(sim, batch)
+        col, cm = col_step(col, batch)
+    np.testing.assert_allclose(
+        float(sm["consensus_error"]), float(cm["consensus_error"]), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(sim.params), jax.tree.leaves(col.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_corrections_sum_to_zero():
+    """Mean-exactness: for doubly stochastic W the per-worker corrections
+    cancel, so the network mean evolves by local updates alone."""
+    topo = RingTopology(WORLD)
+    cfg = _cfg(topo, lr=0.05)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(MLP(hidden=8)))
+    state = init_stacked_state(
+        cfg, lambda r: MLP(hidden=8).init(r, jnp.zeros((1, 8, 8, 1)))["params"],
+        jax.random.key(1), WORLD,
+    )
+    for batch in _batches(cfg, 3):
+        state, _ = step(state, batch)
+    assert isinstance(state.gossip, OverlapState)
+    for leaf in jax.tree.leaves(state.gossip.correction):
+        total = np.asarray(jnp.sum(leaf, axis=0))
+        np.testing.assert_allclose(total, np.zeros_like(total), atol=1e-4)
+
+
+def test_training_converges_with_overlap():
+    topo = RingTopology(WORLD)
+    cfg = _cfg(topo, lr=0.1, h=2)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(MLP(hidden=16)))
+    state = init_stacked_state(
+        cfg, lambda r: MLP(hidden=16).init(r, jnp.zeros((1, 8, 8, 1)))["params"],
+        jax.random.key(2), WORLD,
+    )
+    losses, errs = [], []
+    for batch in _batches(cfg, 30):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+        errs.append(float(m["consensus_error"]))
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5])
+    assert errs[-1] < errs[0]
+
+
+def test_overlap_rejects_incompatible_configs():
+    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.consensus import FaultConfig
+    from consensusml_tpu.train import SlowMoConfig
+
+    topo = RingTopology(WORLD)
+    with pytest.raises(NotImplementedError, match="compression"):
+        GossipConfig(
+            topology=topo, overlap=True,
+            compressor=topk_int8_compressor(ratio=0.1, chunk=128),
+        )
+    with pytest.raises(NotImplementedError, match="push-sum"):
+        GossipConfig(topology=topo, overlap=True, push_sum=True)
+    with pytest.raises(NotImplementedError, match="fault"):
+        GossipConfig(
+            topology=topo, overlap=True, faults=FaultConfig(drop_prob=0.1)
+        )
+    with pytest.raises(NotImplementedError, match="SlowMo"):
+        LocalSGDConfig(
+            gossip=GossipConfig(topology=topo, overlap=True),
+            optimizer=optax.sgd(0.1),
+            outer=SlowMoConfig(beta=0.5),
+        )
+
+
+def test_time_varying_overlap_backends_agree():
+    """One-peer exponential (time-varying): the phase a correction is
+    computed with must match across backends round for round."""
+    from consensusml_tpu.topology import OnePeerExponentialTopology
+
+    topo = OnePeerExponentialTopology(WORLD)
+    cfg = _cfg(topo, lr=0.05, h=1)
+    loss_fn = mlp_loss_fn(MLP(hidden=8))
+    init = lambda r: MLP(hidden=8).init(r, jnp.zeros((1, 8, 8, 1)))["params"]
+    sim_step = make_simulated_train_step(cfg, loss_fn)
+    col_step = make_collective_train_step(
+        cfg, loss_fn, WorkerMesh.create(topo, devices=jax.devices()[:WORLD])
+    )
+    sim = init_stacked_state(cfg, init, jax.random.key(3), WORLD)
+    col = jax.tree.map(jnp.copy, sim)
+    # > one full period so every phase's correction is exercised
+    for batch in _batches(cfg, topo.period + 2, seed=3):
+        sim, sm = sim_step(sim, batch)
+        col, cm = col_step(col, batch)
+    np.testing.assert_allclose(
+        float(sm["consensus_error"]), float(cm["consensus_error"]), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(sim.params), jax.tree.leaves(col.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

@@ -154,7 +154,6 @@ def test_lora_grad_clip_ignores_frozen_base():
 
 
 def test_warmup_longer_than_schedule_raises():
-    with pytest.raises(ValueError, match="warmup"):
-        lr_schedule("cosine", 0.1, 10, warmup_steps=10)
-    with pytest.raises(ValueError, match="warmup"):
-        lr_schedule("linear", 0.1, 10, warmup_steps=12)
+    for kind in ("constant", "cosine", "linear"):
+        with pytest.raises(ValueError, match="warmup"):
+            lr_schedule(kind, 0.1, 10, warmup_steps=10)

@@ -58,6 +58,11 @@ def parse_args(argv=None):
                         '"hierarchical:slices=2,outer_every=4" (multi-slice '
                         'ring-of-rings — inner ring on ICI every round, '
                         'inter-slice ring on DCN 1-in-K rounds)')
+    p.add_argument("--overlap-gossip", action="store_true",
+                   help="combine-then-adapt gossip: the mixing correction is "
+                        "computed from pre-inner-loop params and applied next "
+                        "round, letting XLA overlap the communication with "
+                        "the H local steps (exact gossip only)")
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
@@ -270,14 +275,29 @@ def main(argv=None) -> int:
                 gossip, faults=FaultConfig(drop_prob=args.drop_prob)
             )
         bundle.cfg = dataclasses.replace(bundle.cfg, gossip=gossip)
+    if args.overlap_gossip:
+        import dataclasses
+
+        try:
+            bundle.cfg = dataclasses.replace(
+                bundle.cfg,
+                gossip=dataclasses.replace(bundle.cfg.gossip, overlap=True),
+            )
+        except NotImplementedError as e:
+            print(f"error: --overlap-gossip: {e}", file=sys.stderr)
+            return 2
     if args.slowmo_beta is not None:
         import dataclasses
 
         from consensusml_tpu.train import SlowMoConfig
 
-        bundle.cfg = dataclasses.replace(
-            bundle.cfg, outer=SlowMoConfig(beta=args.slowmo_beta)
-        )
+        try:
+            bundle.cfg = dataclasses.replace(
+                bundle.cfg, outer=SlowMoConfig(beta=args.slowmo_beta)
+            )
+        except NotImplementedError as e:
+            print(f"error: --slowmo-beta: {e}", file=sys.stderr)
+            return 2
 
     model_axes = bundle.model_axes
     user_set_axes = args.model_axes is not None
