@@ -161,9 +161,9 @@ class ConsensusEngine:
     # ---- state ----------------------------------------------------------
     def init_state(
         self, params: Any, world_size: int | None = None
-    ) -> ChocoState | PushSumState | None:
+    ) -> ChocoState | PushSumState | OverlapState | None:
         """Gossip state: zero CHOCO state shaped like ``params``, unit
-        push-sum mass, or None for exact mixing.
+        push-sum mass, zero overlap correction, or None for exact mixing.
 
         Works for both backends: pass per-worker params (collective) or
         stacked params with ``world_size`` (simulated / host-side stacked

@@ -53,7 +53,7 @@ class TrainState(NamedTuple):
     params: Any
     model_state: Any  # non-gradient mutables (BN stats, ...); {} if none
     opt_state: Any
-    gossip: Any  # ChocoState | PushSumState | None per GossipConfig
+    gossip: Any  # ChocoState | PushSumState | OverlapState | None per GossipConfig
     rng: jax.Array
     outer: Any = None  # SlowMo {x, u} when LocalSGDConfig.outer is set
 
@@ -254,11 +254,13 @@ def make_collective_train_step(
                 _gossiped(state.params, state.model_state), state.gossip
             )
             gossip = engine.correction_collective(z, step=state.step)
+            # post-gossip measurement point, same as every other mode:
+            # z is the params right after the mixing correction landed
+            err = engine.consensus_error_collective(z["params"])
             params, model_state, opt_state, rng, loss = _inner_loop(
                 cfg, loss_fn, z["params"], z["model_state"], state.opt_state,
                 state.rng, batch,
             )
-            err = engine.consensus_error_collective(params)
             new_state = TrainState(
                 step=state.step + 1,
                 params=params,
@@ -396,6 +398,8 @@ def make_simulated_train_step(
                 _gossiped(state.params, state.model_state), state.gossip
             )
             gossip = engine.correction_simulated(z, w)
+            # post-gossip measurement point, same as every other mode
+            err = engine.consensus_error_simulated(z["params"])
             params, model_state, opt_state, rng, losses = jax.vmap(worker)(
                 z["params"], z["model_state"], state.opt_state, state.rng, batch
             )
@@ -410,7 +414,7 @@ def make_simulated_train_step(
             )
             return new_state, {
                 "loss": jnp.mean(losses),
-                "consensus_error": engine.consensus_error_simulated(params),
+                "consensus_error": err,
             }
         params, model_state, opt_state, rng, losses = jax.vmap(worker)(
             state.params, state.model_state, state.opt_state, state.rng, batch

@@ -29,6 +29,11 @@ def lr_schedule(
     """``constant`` | ``cosine`` | ``linear`` with ``warmup_steps`` of
     linear warmup from 0. Returns a plain float for the no-op case so the
     optimizer state stays schedule-free when nothing was requested."""
+    if kind in ("cosine", "linear") and total_steps <= 0:
+        raise ValueError(
+            f"kind={kind!r} decays over the horizon and needs "
+            f"total_steps > 0 (got {total_steps})"
+        )
     if warmup_steps > 0 and warmup_steps >= total_steps:
         raise ValueError(
             f"warmup ({warmup_steps} steps) must be shorter than the "

@@ -1,0 +1,153 @@
+"""Convergence comparison across the gossip modes (docs/convergence.md).
+
+Same workload, same seeds, same data order for every variant: 8-worker
+MLP classification (the mnist_mlp shape), h=2 local steps, ring-family
+topologies, simulated backend on CPU. Reports final loss, consensus
+error, and held-out top-1 of the consensus (mean) model — the apparatus
+behind the north star's "identical convergence" clause: any two modes
+can be compared on equal footing, and the numbers in docs/convergence.md
+were produced by exactly this script.
+
+Usage:  python tools/convergence_study.py [--rounds N] [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+WORLD, H, BATCH, HIDDEN = 8, 2, 16, 32
+
+
+def variants():
+    import optax
+
+    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.consensus import GossipConfig
+    from consensusml_tpu.topology import (
+        OnePeerExponentialTopology,
+        RingTopology,
+    )
+    from consensusml_tpu.train import LocalSGDConfig, SlowMoConfig
+
+    ring = RingTopology(WORLD)
+    tx = lambda: optax.sgd(0.05)
+    return {
+        "exact ring": LocalSGDConfig(
+            gossip=GossipConfig(topology=ring), optimizer=tx(), h=H
+        ),
+        "overlap ring": LocalSGDConfig(
+            gossip=GossipConfig(topology=ring, overlap=True), optimizer=tx(), h=H
+        ),
+        "choco topk+int8 (51x less wire)": LocalSGDConfig(
+            gossip=GossipConfig(
+                topology=ring,
+                compressor=topk_int8_compressor(ratio=0.1, chunk=128),
+                gamma=0.5,
+            ),
+            optimizer=tx(),
+            h=H,
+        ),
+        "push-sum one-peer (directed)": LocalSGDConfig(
+            gossip=GossipConfig(
+                topology=OnePeerExponentialTopology(WORLD), push_sum=True
+            ),
+            optimizer=tx(),
+            h=H,
+        ),
+        "exact ring + SlowMo": LocalSGDConfig(
+            gossip=GossipConfig(topology=ring),
+            optimizer=tx(),
+            h=H,
+            outer=SlowMoConfig(beta=0.5),
+        ),
+    }
+
+
+def run_variant(cfg, rounds: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensusml_tpu.data import SyntheticClassification, round_batches
+    from consensusml_tpu.models import MLP, mlp_loss_fn
+    from consensusml_tpu.train import (
+        classification_eval_fn,
+        evaluate,
+        init_stacked_state,
+        make_simulated_train_step,
+    )
+
+    model = MLP(hidden=HIDDEN)
+    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
+    state = init_stacked_state(
+        cfg,
+        lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
+        jax.random.key(0),
+        WORLD,
+    )
+    # noise high enough that the Bayes rate is < 1: an all-1.0 table
+    # would say nothing about the modes' relative convergence
+    data = SyntheticClassification(n=2048, image_shape=(28, 28, 1), noise=3.0)
+    losses, errs = [], []
+    for batch in round_batches(data, WORLD, cfg.h, BATCH, rounds):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+        errs.append(float(m["consensus_error"]))
+
+    held = data.holdout(512)
+
+    def eval_batches(n_batches):
+        for r in range(n_batches):
+            yield {
+                "image": jnp.asarray(held.images[r * 64 : (r + 1) * 64]),
+                "label": jnp.asarray(held.labels[r * 64 : (r + 1) * 64]),
+            }
+
+    ev = evaluate(classification_eval_fn(model), state, eval_batches(8))
+    return {
+        "final_loss": round(float(np.mean(losses[-5:])), 4),
+        "consensus_error": round(errs[-1], 4),
+        "top1_consensus_model": round(float(ev["mean_model"]["top1"]), 4),
+        "top1_worker_mean": round(float(ev["worker_mean"]["top1"]), 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=80)
+    ap.add_argument("--md", action="store_true", help="print a markdown table")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    rows = {}
+    for name, cfg in variants().items():
+        rows[name] = run_variant(cfg, args.rounds)
+        print(f"# {name}: {json.dumps(rows[name])}", file=sys.stderr, flush=True)
+
+    if args.md:
+        print(
+            "| mode | final loss | consensus error | top-1 (consensus model)"
+            " | top-1 (worker mean) |"
+        )
+        print("|---|---|---|---|---|")
+        for name, r in rows.items():
+            print(
+                f"| {name} | {r['final_loss']} | {r['consensus_error']} "
+                f"| {r['top1_consensus_model']} | {r['top1_worker_mean']} |"
+            )
+    else:
+        print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()
