@@ -34,7 +34,9 @@ def lr_schedule(
             f"kind={kind!r} decays over the horizon and needs "
             f"total_steps > 0 (got {total_steps})"
         )
-    if warmup_steps > 0 and warmup_steps >= total_steps:
+    # a pure-warmup constant schedule needs no horizon; the decaying
+    # kinds (validated above to have one) must finish warming up first
+    if warmup_steps > 0 and total_steps > 0 and warmup_steps >= total_steps:
         raise ValueError(
             f"warmup ({warmup_steps} steps) must be shorter than the "
             f"schedule ({total_steps} steps)"

@@ -171,3 +171,29 @@ def test_time_varying_overlap_backends_agree():
     )
     for a, b in zip(jax.tree.leaves(sim.params), jax.tree.leaves(col.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_hierarchical_overlap_backends_agree():
+    """Overlap over the multi-slice topology: inner-ring rounds and the
+    1-in-K inter-slice round each produce corrections with THEIR phase's
+    W, applied one round later — backends must agree across the period."""
+    from consensusml_tpu.topology import HierarchicalTopology
+
+    topo = HierarchicalTopology(slices=2, inner=4, outer_every=2)
+    cfg = _cfg(topo, lr=0.05, h=1)
+    loss_fn = mlp_loss_fn(MLP(hidden=8))
+    init = lambda r: MLP(hidden=8).init(r, jnp.zeros((1, 8, 8, 1)))["params"]
+    sim_step = make_simulated_train_step(cfg, loss_fn)
+    col_step = make_collective_train_step(
+        cfg, loss_fn, WorkerMesh.create(topo, devices=jax.devices()[:WORLD])
+    )
+    sim = init_stacked_state(cfg, init, jax.random.key(4), WORLD)
+    col = jax.tree.map(jnp.copy, sim)
+    for batch in _batches(cfg, 2 * topo.period, seed=4):
+        sim, sm = sim_step(sim, batch)
+        col, cm = col_step(col, batch)
+    np.testing.assert_allclose(
+        float(sm["consensus_error"]), float(cm["consensus_error"]), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(sim.params), jax.tree.leaves(col.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
