@@ -22,11 +22,14 @@ from consensusml_tpu.compress.base import (  # noqa: F401
     ComposedCompressor,
     Compressor,
     IdentityCompressor,
+    Int4Payload,
     Int8Payload,
+    LocalTopKPayload,
     TopKPayload,
 )
 from consensusml_tpu.compress.kernels import (  # noqa: F401
     ChunkedTopKCompressor,
+    PallasInt4Compressor,
     PallasInt8Compressor,
 )
 from consensusml_tpu.compress.extra import (  # noqa: F401
@@ -38,7 +41,9 @@ from consensusml_tpu.compress.extra import (  # noqa: F401
     SignPayload,
 )
 from consensusml_tpu.compress.reference import (  # noqa: F401
+    Int4Compressor,
     Int8Compressor,
     TopKCompressor,
+    topk_int4_compressor,
     topk_int8_compressor,
 )

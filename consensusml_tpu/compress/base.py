@@ -18,7 +18,9 @@ import jax.numpy as jnp
 __all__ = [
     "Compressor",
     "TopKPayload",
+    "LocalTopKPayload",
     "Int8Payload",
+    "Int4Payload",
     "IdentityCompressor",
     "ComposedCompressor",
     "static_k",
@@ -62,6 +64,58 @@ class Int8Payload:
     """Per-chunk symmetric int8 quantization: int8 data + f32 chunk scales."""
 
     data: jax.Array  # (padded_n,) int8
+    scales: jax.Array  # (num_chunks,) float32
+    shape: tuple[int, ...]
+    dtype: Any
+    chunk: int
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.shape, self.dtype, self.chunk)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class LocalTopKPayload:
+    """Chunked top-k with NARROW local indices: ``indices[c, j]`` is the
+    position of winner ``j`` INSIDE chunk ``c`` (uint16 — chunks are
+    always <= 65536 wide), reconstructed to global positions at decode.
+    Halves the index wire vs int32 globals; with small k the indices are
+    most of a sparse payload's bytes, so this matters more than value
+    quantization width.
+    """
+
+    values: jax.Array  # (nchunks * k,) in compute dtype (or nested payload)
+    indices: jax.Array  # (nchunks, k) uint16, chunk-local
+    shape: tuple[int, ...]
+    dtype: Any
+    chunk: int
+
+    def tree_flatten(self):
+        return (self.values, self.indices), (self.shape, self.dtype, self.chunk)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Int4Payload:
+    """Per-chunk symmetric int4 quantization, two values per byte.
+
+    Wire format (half-split pairing, chosen to keep the pack/unpack
+    lane-contiguous in the Pallas kernel): within each ``chunk``-sized
+    row, byte ``j`` carries element ``j`` in its LOW nibble and element
+    ``j + chunk//2`` in its HIGH nibble; nibbles are two's-complement in
+    ``[-7, 7]`` (``-8`` never produced), ``scale = absmax / 7`` per
+    chunk.
+    """
+
+    data: jax.Array  # (num_chunks * chunk // 2,) uint8
     scales: jax.Array  # (num_chunks,) float32
     shape: tuple[int, ...]
     dtype: Any
@@ -184,7 +238,7 @@ class ComposedCompressor(Compressor):
     payload's ``values`` leaf only (indices stay exact int32).
     """
 
-    inner: Compressor  # produces a TopKPayload
+    inner: Compressor  # produces a TopKPayload or LocalTopKPayload
     outer: Compressor  # applied to payload.values
 
     @property
@@ -202,13 +256,12 @@ class ComposedCompressor(Compressor):
             {"rng": jax.random.fold_in(rng, tag)} if c.stochastic else {}
         )
         p = self.inner.compress(x, **sub(self.inner, 0))
-        if not isinstance(p, TopKPayload):
-            raise TypeError("ComposedCompressor.inner must produce TopKPayload")
-        return TopKPayload(
-            values=self.outer.compress(p.values, **sub(self.outer, 1)),
-            indices=p.indices,
-            shape=p.shape,
-            dtype=p.dtype,
+        if not isinstance(p, (TopKPayload, LocalTopKPayload)):
+            raise TypeError(
+                "ComposedCompressor.inner must produce a top-k payload"
+            )
+        return dataclasses.replace(
+            p, values=self.outer.compress(p.values, **sub(self.outer, 1))
         )
 
     def decompress(self, payload) -> jax.Array:
@@ -221,10 +274,7 @@ class ComposedCompressor(Compressor):
             self._inner_payload(payload), acc, weight
         )
 
-    def _inner_payload(self, payload) -> TopKPayload:
-        return TopKPayload(
-            values=self.outer.decompress(payload.values),
-            indices=payload.indices,
-            shape=payload.shape,
-            dtype=payload.dtype,
+    def _inner_payload(self, payload):
+        return dataclasses.replace(
+            payload, values=self.outer.decompress(payload.values)
         )

@@ -30,13 +30,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from consensusml_tpu.compress.base import Compressor, Int8Payload, TopKPayload
+from consensusml_tpu.compress.base import (
+    Compressor,
+    Int4Payload,
+    Int8Payload,
+    LocalTopKPayload,
+    TopKPayload,
+)
 
 __all__ = [
     "ChunkedTopKCompressor",
     "PallasInt8Compressor",
+    "PallasInt4Compressor",
     "quantize_int8",
     "dequantize_int8",
+    "quantize_int4",
+    "dequantize_int4",
     "chunked_topk",
 ]
 
@@ -131,6 +140,92 @@ def dequantize_int8(q: jax.Array, scales: jax.Array, *, interpret: bool = False)
         out_shape=jax.ShapeDtypeStruct((rows, chunk), jnp.float32),
         interpret=interpret,
     )(q, scales.reshape(-1, 1))
+    return out[:nchunks]
+
+
+# ---------------------------------------------------------------------------
+# int4 quantize / dequantize (two values per byte, half-split pairing)
+# ---------------------------------------------------------------------------
+
+
+def _quant4_kernel(half: int, x_ref, p_ref, s_ref):
+    x = x_ref[:]
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / 7.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(jnp.rint(x * inv), -7, 7).astype(jnp.int32)
+    lo = q[:, :half] & 0xF
+    hi = (q[:, half:] & 0xF) << 4
+    p_ref[:] = (lo | hi).astype(jnp.uint8)
+    s_ref[:] = scale
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int4(chunks: jax.Array, *, interpret: bool = False):
+    """Quantize ``(nchunks, chunk)`` f32 rows to packed int4 nibbles.
+
+    Returns ``(packed (nchunks, chunk//2) uint8, scales (nchunks,) f32)``
+    with byte ``j`` holding elements ``j`` (low nibble) and
+    ``j + chunk//2`` (high) — one fused absmax→quantize→pack pass.
+    ``chunk`` must be a multiple of 128.
+    """
+    nchunks, chunk = chunks.shape
+    half = chunk // 2
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = min(rows, 256)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
+    packed, scales = pl.pallas_call(
+        functools.partial(_quant4_kernel, half),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, half), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, half), jnp.uint8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(chunks)
+    return packed[:nchunks], scales[:nchunks, 0]
+
+
+def _dequant4_kernel(p_ref, s_ref, out_ref):
+    b = p_ref[:].astype(jnp.int32)
+    sext = lambda nib: jnp.where(nib > 7, nib - 16, nib)
+    q = jnp.concatenate([sext(b & 0xF), sext(b >> 4)], axis=1)
+    out_ref[:] = q.astype(jnp.float32) * s_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequantize_int4(packed: jax.Array, scales: jax.Array, *, interpret: bool = False):
+    """Inverse of :func:`quantize_int4`: ``(nchunks, half) uint8 ->
+    (nchunks, 2*half) f32``."""
+    nchunks, half = packed.shape
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = min(rows, 256)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        packed = jnp.pad(packed, ((0, rows - nchunks), (0, 0)))
+        scales = jnp.pad(scales, (0, rows - nchunks))
+    out = pl.pallas_call(
+        _dequant4_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, half), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, 2 * half), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, 2 * half), jnp.float32),
+        interpret=interpret,
+    )(packed, scales.reshape(-1, 1))
     return out[:nchunks]
 
 
@@ -275,6 +370,55 @@ class PallasInt8Compressor(Compressor):
 
 
 @dataclasses.dataclass(frozen=True)
+class PallasInt4Compressor(Compressor):
+    """Per-chunk symmetric int4 codec backed by the fused Pallas kernels
+    (same impl contract as :class:`PallasInt8Compressor`; payload format
+    defined by :class:`~consensusml_tpu.compress.base.Int4Payload`)."""
+
+    chunk: int = 512
+    impl: str = "auto"
+
+    def __post_init__(self):
+        if self.chunk % _LANE:
+            raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
+
+    def compress(self, x: jax.Array) -> Int4Payload:
+        n = x.size
+        chunk = min(self.chunk, _round_up(n, _LANE))
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Int4Compressor
+
+            return Int4Compressor(chunk=chunk).compress(x)
+        flat = jnp.asarray(x.reshape(-1), jnp.float32)
+        pad = (-n) % chunk
+        chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+        packed, scales = quantize_int4(chunks, interpret=impl == "interpret")
+        return Int4Payload(
+            data=packed.reshape(-1),
+            scales=scales,
+            shape=x.shape,
+            dtype=x.dtype,
+            chunk=chunk,
+        )
+
+    def decompress(self, payload: Int4Payload) -> jax.Array:
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Int4Compressor
+
+            return Int4Compressor(chunk=payload.chunk).decompress(payload)
+        packed = payload.data.reshape(-1, payload.chunk // 2)
+        flat = dequantize_int4(
+            packed, payload.scales, interpret=impl == "interpret"
+        ).reshape(-1)
+        n = 1
+        for d in payload.shape:
+            n *= d
+        return flat[:n].astype(payload.dtype).reshape(payload.shape)
+
+
+@dataclasses.dataclass(frozen=True)
 class ChunkedTopKCompressor(Compressor):
     """Per-chunk (local) top-k sparsification.
 
@@ -290,6 +434,9 @@ class ChunkedTopKCompressor(Compressor):
     chunk: int = 512
     k_per_chunk: int = 16
     impl: str = "auto"
+    # uint16 chunk-local indices (LocalTopKPayload): halves the index
+    # bytes, which dominate a small-k sparse payload's wire
+    narrow_indices: bool = True
 
     # the kernel extracts one winner per pass (O(k) VMEM sweeps): great
     # for the small k sparsification uses, a loss past this point — fall
@@ -301,6 +448,12 @@ class ChunkedTopKCompressor(Compressor):
             raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
         if not 0 < self.k_per_chunk <= self.chunk:
             raise ValueError("k_per_chunk must be in (0, chunk]")
+        if self.narrow_indices and self.chunk > 2**16:
+            raise ValueError(
+                f"narrow_indices stores chunk-local positions as uint16, so "
+                f"chunk must be <= {2**16} (got {self.chunk}); pass "
+                "narrow_indices=False for wider chunks"
+            )
 
     def compress(self, x: jax.Array) -> TopKPayload:
         flat = jnp.asarray(x.reshape(-1), jnp.float32)
@@ -323,24 +476,49 @@ class ChunkedTopKCompressor(Compressor):
         # padded tail indices may point past n; clamp to a real slot and
         # zero their values so decompress scatters nothing
         valid = gidx < n
+        values = jnp.where(valid, vals.reshape(-1), 0.0).astype(x.dtype)
+        if self.narrow_indices:
+            return LocalTopKPayload(
+                values=values,
+                indices=lidx.astype(jnp.uint16),
+                shape=x.shape,
+                dtype=x.dtype,
+                chunk=chunk,
+            )
         gidx = jnp.where(valid, gidx, 0)
-        values = jnp.where(valid, vals.reshape(-1), 0.0)
         return TopKPayload(
-            values=values.astype(x.dtype), indices=gidx, shape=x.shape, dtype=x.dtype
+            values=values, indices=gidx, shape=x.shape, dtype=x.dtype
         )
 
-    def decompress(self, payload: TopKPayload) -> jax.Array:
+    @staticmethod
+    def _global_indices(payload, n: int) -> jax.Array:
+        """Flat int32 scatter targets for either payload form (padded-tail
+        slots clamp to 0; their values are zero, so they add nothing)."""
+        if isinstance(payload, LocalTopKPayload):
+            lidx = payload.indices.astype(jnp.int32)
+            offsets = (
+                jnp.arange(lidx.shape[0], dtype=jnp.int32) * payload.chunk
+            )[:, None]
+            gidx = (lidx + offsets).reshape(-1)
+            return jnp.where(gidx < n, gidx, 0)
+        return payload.indices
+
+    def decompress(self, payload) -> jax.Array:
         n = 1
         for d in payload.shape:
             n *= d
         flat = jnp.zeros((n,), payload.dtype)
-        flat = flat.at[payload.indices].add(jnp.asarray(payload.values, payload.dtype))
+        flat = flat.at[self._global_indices(payload, n)].add(
+            jnp.asarray(payload.values, payload.dtype)
+        )
         return flat.reshape(payload.shape)
 
-    def decompress_accumulate(self, payload: TopKPayload, acc, weight):
+    def decompress_accumulate(self, payload, acc, weight):
         """Fused scatter-add receive (padded-tail slots carry zero values,
         so the duplicate index-0 entries add nothing — same semantics as
         :meth:`decompress` + axpy, without the dense temporary)."""
         flat = acc.reshape(-1)
         vals = weight * jnp.asarray(payload.values, flat.dtype)
-        return flat.at[payload.indices].add(vals).reshape(acc.shape)
+        return flat.at[self._global_indices(payload, flat.size)].add(
+            vals
+        ).reshape(acc.shape)

@@ -216,3 +216,20 @@ def test_train_lr_schedule_flags(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [json.loads(l) for l in metrics.read_text().splitlines()]
     assert lines[-1]["loss"] < lines[0]["loss"]
+
+
+def test_train_codec_override(tmp_path):
+    """--codec swaps the compressed-gossip codec (and is rejected on
+    exact-mixing configs)."""
+    r = _run(
+        ["train.py", "--config", "gpt2_topk", "--device", "cpu",
+         "--rounds", "2", "--codec", "topk_int4"],
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "final: loss=" in r.stdout
+    bad = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "1", "--codec", "topk_int4"],
+    )
+    assert bad.returncode == 2
+    assert "exact mixing" in bad.stderr

@@ -33,7 +33,8 @@ if jax.default_backend() not in ("tpu", "axon"):
     raise SystemExit(0)
 
 from consensusml_tpu.compress.kernels import (
-    chunked_topk, dequantize_int8, quantize_int8,
+    chunked_topk, dequantize_int4, dequantize_int8, quantize_int4,
+    quantize_int8,
 )
 from consensusml_tpu.compress.reference import chunk_for_quantization
 
@@ -51,6 +52,19 @@ out["scales_exact"] = bool(np.allclose(np.asarray(s), np.asarray(refs)))
 d = dequantize_int8(q, s)
 out["dequant_exact"] = bool(
     np.allclose(np.asarray(d), np.asarray(q, np.float32) * np.asarray(s)[:, None])
+)
+
+from consensusml_tpu.compress.reference import Int4Compressor
+chunks4 = jnp.asarray(rng.normal(size=(96, 256)), jnp.float32)
+p4, s4 = quantize_int4(chunks4)
+ref4 = Int4Compressor(chunk=256).compress(chunks4.reshape(-1))
+out["int4_pack_exact"] = bool(
+    np.array_equal(np.asarray(p4).reshape(-1), np.asarray(ref4.data))
+)
+d4 = dequantize_int4(p4, s4)
+ref_dec = Int4Compressor(chunk=256).decompress(ref4)
+out["int4_roundtrip_ok"] = bool(
+    np.allclose(np.asarray(d4).reshape(-1), np.asarray(ref_dec), atol=1e-5)
 )
 
 ok_topk = True
@@ -89,6 +103,8 @@ def test_pallas_kernels_match_reference_on_tpu():
     assert result["quant_exact"], result
     assert result["scales_exact"], result
     assert result["dequant_exact"], result
+    assert result["int4_pack_exact"], result
+    assert result["int4_roundtrip_ok"], result
     assert result["topk_exact"], result
 
 

@@ -45,7 +45,7 @@ def variants():
         "overlap ring": LocalSGDConfig(
             gossip=GossipConfig(topology=ring, overlap=True), optimizer=tx(), h=H
         ),
-        "choco topk+int8 (51x less wire)": LocalSGDConfig(
+        "choco topk+int8": LocalSGDConfig(
             gossip=GossipConfig(
                 topology=ring,
                 compressor=topk_int8_compressor(ratio=0.1, chunk=128),

@@ -58,6 +58,11 @@ def parse_args(argv=None):
                         '"hierarchical:slices=2,outer_every=4" (multi-slice '
                         'ring-of-rings — inner ring on ICI every round, '
                         'inter-slice ring on DCN 1-in-K rounds)')
+    p.add_argument("--codec", default=None,
+                   choices=["topk_int8", "topk_int4"],
+                   help="swap the compressed-gossip codec on a compressed "
+                        "config (topk_int4 = half the wire of the config-5 "
+                        "default; same top-k, 4-bit value quantization)")
     p.add_argument("--overlap-gossip", action="store_true",
                    help="combine-then-adapt gossip: the mixing correction is "
                         "computed from pre-inner-loop params and applied next "
@@ -275,6 +280,34 @@ def main(argv=None) -> int:
                 gossip, faults=FaultConfig(drop_prob=args.drop_prob)
             )
         bundle.cfg = dataclasses.replace(bundle.cfg, gossip=gossip)
+    if args.codec is not None:
+        import dataclasses
+
+        if bundle.cfg.gossip.compressor is None:
+            print(
+                f"error: --codec only applies to compressed-gossip configs "
+                f"({args.config} uses exact mixing)",
+                file=sys.stderr,
+            )
+            return 2
+        from consensusml_tpu.compress import (
+            topk_int4_compressor,
+            topk_int8_compressor,
+        )
+
+        make = {
+            "topk_int8": topk_int8_compressor,
+            "topk_int4": topk_int4_compressor,
+        }[args.codec]
+        comp = (
+            make(chunk=512, k=8, impl="auto")
+            if scale == "full"
+            else make(ratio=0.1, chunk=128, impl="auto")
+        )
+        bundle.cfg = dataclasses.replace(
+            bundle.cfg,
+            gossip=dataclasses.replace(bundle.cfg.gossip, compressor=comp),
+        )
     if args.overlap_gossip:
         import dataclasses
 
