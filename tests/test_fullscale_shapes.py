@@ -127,6 +127,18 @@ info["down_shard"] = list(down.sharding.shard_shape(down.shape))
 assert info["down_shard"] == [1, down.shape[1] // 4, down.shape[2]], info
 out["llama_lora"] = info
 
+# ---- gpt2_topk full: 8-worker ring, GPT-2-medium, CHOCO compressed
+# gossip (uint16 local-index payloads ride the ppermutes at full scale)
+def gpt2_batch(bundle):
+    return {"input_ids": jax.ShapeDtypeStruct((8, 2, 8, 1024), jnp.int32)}
+
+
+state_in, info = lower_one("gpt2_topk", (), None, gpt2_batch)
+gossip_leaves = jax.tree.leaves(state_in.gossip)
+info["choco_state_leaves"] = len(gossip_leaves)
+assert info["choco_state_leaves"] > 0  # xhat/s tracked per gossiped leaf
+out["gpt2_topk"] = info
+
 print("RESULT " + json.dumps(out))
 """
 
@@ -145,3 +157,5 @@ def test_fullscale_bert_and_llama_tp_lower():
     assert out["bert_mlm"]["hlo_len"] > 1000
     assert out["llama_lora"]["hlo_len"] > 1000
     assert out["llama_lora"]["per_worker"] == 4
+    assert out["gpt2_topk"]["hlo_len"] > 1000
+    assert out["gpt2_topk"]["choco_state_leaves"] > 0

@@ -68,3 +68,70 @@ def test_ring_attention_long_sequence_numerics():
     got = _run_ring(q, k, v, 8, False)
     assert np.isfinite(got).all()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_parallel_training_end_to_end():
+    """A tiny causal LM TRAINS under sequence parallelism: tokens sharded
+    (1/8 of the sequence per device), ring attention across shards,
+    psum'd loss and gradients, replicated params — loss must fall. This
+    is the long-context training recipe composed end to end, not just
+    the attention exactness check."""
+    import optax
+
+    from consensusml_tpu.data import SyntheticLM
+
+    n, b, s, d, v = 8, 4, 256, 32, 64
+    mesh = _mesh(n)
+    shard = NamedSharding(mesh, P(None, "sp"))
+
+    def init_params(rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        scale = 0.08
+        return {
+            "emb": scale * jax.random.normal(k1, (v, d)),
+            "qkv": scale * jax.random.normal(k2, (d, 3, 1, d)),  # 1 head
+            "out": scale * jax.random.normal(k3, (d, d)),
+            "head": scale * jax.random.normal(k4, (d, v)),
+        }
+
+    def forward_local(params, ids_local):
+        x = params["emb"][ids_local]  # (b, s/n, d)
+        qkv = jnp.einsum("bsd,dche->bsche", x, params["qkv"])
+        q, k, kv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = ring_attention(q, k, kv, "sp", causal=True)  # (b, s/n, 1, d)
+        x = x + jnp.einsum("bshe,ed->bsd", a, params["out"])
+        return jnp.einsum("bsd,dv->bsv", x, params["head"])  # logits
+
+    tx = optax.adam(1e-2)
+
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh,
+        in_specs=(P(), P(), P(None, "sp"), P(None, "sp")),
+        out_specs=(P(), P(), P()),
+    )
+    def train_step(params, opt_state, ids_local, labels_local):
+        def loss_fn(p):
+            logits = forward_local(p, ids_local)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, labels_local[..., None], -1)
+            # global mean: psum the shard sums, divide by global count
+            return jax.lax.psum(jnp.sum(nll), "sp") / (b * s)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # replicated params need the cross-shard gradient sum
+        grads = jax.lax.psum(grads, "sp")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    data = SyntheticLM(vocab_size=v, seq_len=s + 1)
+    params = init_params(jax.random.key(0))
+    opt_state = tx.init(params)
+    losses = []
+    for step in range(60):
+        tok = data.sample(np.random.default_rng((0, step)), (b,))
+        ids = jax.device_put(jnp.asarray(tok[:, :-1]), shard)
+        labels = jax.device_put(jnp.asarray(tok[:, 1:]), shard)
+        params, opt_state, loss = train_step(params, opt_state, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
