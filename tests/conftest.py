@@ -62,6 +62,7 @@ _SLOW_TESTS = {
     "test_train_topology_override_bad_name",
     "test_train_lr_schedule_flags",
     "test_train_codec_override",
+    "test_train_eval_every",
     "test_lora_grad_clip_ignores_frozen_base",
     # time-varying topology convergence
     "test_onepeer_beats_ring_consensus_decay",

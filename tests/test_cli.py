@@ -233,3 +233,19 @@ def test_train_codec_override(tmp_path):
     )
     assert bad.returncode == 2
     assert "exact mixing" in bad.stderr
+
+
+def test_train_eval_every(tmp_path):
+    """--eval-every K runs the held-out eval during training."""
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "4", "--eval-batches", "2", "--eval-every", "2"],
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "[round 1] eval[mean-model]" in r.stdout
+    assert "[round 3] eval[mean-model]" in r.stdout
+    bad = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "1", "--eval-every", "2"],
+    )
+    assert bad.returncode == 2 and "--eval-batches" in bad.stderr

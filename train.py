@@ -94,6 +94,9 @@ def parse_args(argv=None):
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument("--profile-dir", default=None,
                    help="dump an xprof trace of rounds 2-3 to this directory")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="also run the held-out eval every K rounds during "
+                        "training (requires --eval-batches)")
     p.add_argument("--eval-batches", type=int, default=0,
                    help="after training, score this many held-out batches "
                         "(per-worker AND consensus-mean-model top-1/ppl)")
@@ -188,6 +191,17 @@ def main(argv=None) -> int:
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    # fail fast on eval-flag mistakes: the expensive state build /
+    # checkpoint restore below must never run first
+    if args.eval_every > 0 and args.eval_batches <= 0:
+        print("error: --eval-every requires --eval-batches", file=sys.stderr)
+        return 2
+    if (args.eval_every > 0 or args.eval_batches > 0) and (
+        bundle.eval_fn is None or bundle.eval_batches is None
+    ):
+        print("error: this config has no held-out eval", file=sys.stderr)
         return 2
 
     lr_flags = (
@@ -514,6 +528,26 @@ def main(argv=None) -> int:
     # disk writes overlap the next rounds' compute (sync in multiproc —
     # orbax coordinates the processes inside save)
     saver = AsyncSaver()
+    def run_eval(state, rnd):
+        # evaluate() caches its jitted step per eval_fn, so periodic
+        # calls don't recompile
+        from consensusml_tpu.train import evaluate
+
+        result = evaluate(
+            bundle.eval_fn, state,
+            bundle.eval_batches(args.eval_batches, args.seed),
+        )
+        fmt = lambda d: " ".join(
+            f"{k}={float(v):.4f}" for k, v in sorted(d.items())
+        )
+        tag = f"[round {rnd}] " if rnd is not None else ""
+        print(
+            f"{tag}eval[mean-model]: {fmt(result['mean_model'])}\n"
+            f"{tag}eval[worker-avg]: {fmt(result['worker_mean'])}",
+            flush=True,
+        )
+        return result
+
     batch_source = bundle.batches
     if args.native_loader:
         from consensusml_tpu import native
@@ -552,6 +586,13 @@ def main(argv=None) -> int:
             print(f"profile trace: {args.profile_dir}", flush=True)
         logger.log(rnd, metrics)
         if (
+            args.eval_every > 0
+            and (rnd + 1) % args.eval_every == 0
+            # keep the xprof window (rounds 2-3) pure training compute
+            and isinstance(profiling, contextlib.nullcontext)
+        ):
+            run_eval(state, rnd)
+        if (
             args.checkpoint_dir
             and args.checkpoint_every
             and (rnd + 1) % args.checkpoint_every == 0
@@ -575,21 +616,8 @@ def main(argv=None) -> int:
             f"consensus_error={float(metrics['consensus_error']):.4f}",
             flush=True,
         )
-    if args.eval_batches > 0:
-        if bundle.eval_fn is None or bundle.eval_batches is None:
-            print("error: this config has no held-out eval", file=sys.stderr)
-            return 2
-        from consensusml_tpu.train import evaluate
-
-        result = evaluate(
-            bundle.eval_fn, state, bundle.eval_batches(args.eval_batches, args.seed)
-        )
-        fmt = lambda d: " ".join(f"{k}={float(v):.4f}" for k, v in sorted(d.items()))
-        print(
-            f"eval[mean-model]: {fmt(result['mean_model'])}\n"
-            f"eval[worker-avg]: {fmt(result['worker_mean'])}",
-            flush=True,
-        )
+    if args.eval_batches > 0:  # config's eval support validated up front
+        run_eval(state, None)
     return 0
 
 
