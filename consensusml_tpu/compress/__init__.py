@@ -35,6 +35,7 @@ from consensusml_tpu.compress.kernels import (  # noqa: F401
 from consensusml_tpu.compress.extra import (  # noqa: F401
     LowRankPayload,
     PowerSGDCompressor,
+    QSGD4Compressor,
     QSGDCompressor,
     RandomKCompressor,
     SignCompressor,

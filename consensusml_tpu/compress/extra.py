@@ -10,6 +10,8 @@ so the TPU framework covers the design space users expect:
   covers), optionally n/k-scaled for unbiasedness.
 - :class:`QSGDCompressor` — int8 with *stochastic* rounding (Alistarh et
   al., 2017): unbiased quantization, E[dec(q)] = x.
+- :class:`QSGD4Compressor` — the same unbiased rounding at packed-int4
+  width (8x wire; see :class:`~consensusml_tpu.compress.Int4Payload`).
 - :class:`SignCompressor` — 1-bit sign + per-chunk mean magnitude
   (signSGD, Bernstein et al., 2018), bit-packed to uint8 on the wire for
   a true 32x payload reduction.
@@ -32,11 +34,13 @@ import jax.numpy as jnp
 
 from consensusml_tpu.compress.base import (
     Compressor,
+    Int4Payload,
     Int8Payload,
     TopKPayload,
     static_k as _static_k,
 )
 from consensusml_tpu.compress.reference import (
+    Int4Compressor,
     Int8Compressor,
     TopKCompressor,
     chunk_for_quantization,
@@ -45,6 +49,7 @@ from consensusml_tpu.compress.reference import (
 __all__ = [
     "RandomKCompressor",
     "QSGDCompressor",
+    "QSGD4Compressor",
     "SignCompressor",
     "SignPayload",
     "PowerSGDCompressor",
@@ -107,6 +112,34 @@ class QSGDCompressor(Int8Compressor):
         q = jnp.clip(jnp.floor(chunks * inv[:, None] + u), -127, 127).astype(jnp.int8)
         return Int8Payload(
             data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class QSGD4Compressor(Int4Compressor):
+    """Per-chunk packed int4 with stochastic rounding: unbiased 4-bit
+    quantization (``E[q*scale] = x``) at :class:`Int4Payload`'s 8x wire.
+    Same nibble format as the deterministic codec; only rounding differs
+    (``q = floor(x/scale + u)``, ``u ~ U[0,1)``)."""
+
+    stochastic = True
+
+    def compress(self, x: jax.Array, rng: jax.Array | None = None) -> Int4Payload:
+        if rng is None:
+            raise ValueError("QSGD4Compressor needs rng (stochastic codec)")
+        chunks, scales, inv, chunk = chunk_for_quantization(
+            x, self.chunk, levels=7.0, even_chunk=True
+        )
+        u = jax.random.uniform(rng, chunks.shape)
+        q = jnp.clip(jnp.floor(chunks * inv[:, None] + u), -7, 7).astype(jnp.int32)
+        half = chunk // 2
+        packed = ((q[:, :half] & 0xF) | ((q[:, half:] & 0xF) << 4)).astype(jnp.uint8)
+        return Int4Payload(
+            data=packed.reshape(-1),
+            scales=scales,
+            shape=x.shape,
+            dtype=x.dtype,
+            chunk=chunk,
         )
 
 

@@ -239,11 +239,15 @@ def test_train_eval_every(tmp_path):
     """--eval-every K runs the held-out eval during training."""
     r = _run(
         ["train.py", "--config", "mnist_mlp", "--device", "cpu",
-         "--rounds", "4", "--eval-batches", "2", "--eval-every", "2"],
+         "--rounds", "5", "--eval-batches", "2", "--eval-every", "2"],
     )
     assert r.returncode == 0, r.stderr[-1500:]
     assert "[round 1] eval[mean-model]" in r.stdout
     assert "[round 3] eval[mean-model]" in r.stdout
+    # final round is NOT an eval-every boundary here; the end-of-run
+    # eval still runs untagged (and is never duplicated on boundaries)
+    assert "\neval[mean-model]" in r.stdout
+    assert r.stdout.count("eval[mean-model]") == 3
     bad = _run(
         ["train.py", "--config", "mnist_mlp", "--device", "cpu",
          "--rounds", "1", "--eval-every", "2"],

@@ -143,3 +143,24 @@ def test_narrow_indices_reject_oversized_chunks():
     x = jnp.zeros(2**17).at[70000].set(5.0)
     out = c.decompress(c.compress(x))
     assert float(out[70000]) == 5.0
+
+
+def test_qsgd4_unbiased_and_same_wire():
+    """Stochastic int4: E[decompress(compress(x))] ~= x; identical wire
+    format to the deterministic codec."""
+    from consensusml_tpu.compress import QSGD4Compressor
+
+    comp = QSGD4Compressor(chunk=128)
+    assert comp.stochastic
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.uniform(-1, 1, size=(256,)), jnp.float32)
+    assert comp.wire_bytes((256,), jnp.float32) == Int4Compressor(
+        chunk=128
+    ).wire_bytes((256,), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), 400)
+    dec = jax.vmap(lambda k: comp.decompress(comp.compress(x, rng=k)))(keys)
+    mean = jnp.mean(dec, axis=0)
+    # unbiased: the Monte-Carlo mean approaches x (quant step ~ 1/7)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(x), atol=0.03)
+    with pytest.raises(ValueError, match="rng"):
+        comp.compress(x)

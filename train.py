@@ -590,6 +590,8 @@ def main(argv=None) -> int:
             and (rnd + 1) % args.eval_every == 0
             # keep the xprof window (rounds 2-3) pure training compute
             and isinstance(profiling, contextlib.nullcontext)
+            # the end-of-run eval below covers a final-round boundary
+            and rnd + 1 != start + args.rounds
         ):
             run_eval(state, rnd)
         if (
