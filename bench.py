@@ -123,13 +123,17 @@ def _codec_bench() -> dict:
 
     if os.environ.get("BENCH_DEVICE"):
         jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
-    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.compress import (
+        topk_int4_compressor,
+        topk_int8_compressor,
+    )
 
     shape = (4096, 1024)
     x = jnp.asarray(np.random.default_rng(0).normal(size=shape), jnp.float32)
     out = {"tensor": list(shape), "platform": jax.default_backend()}
     for name, comp in [
         ("pallas", topk_int8_compressor(chunk=512, k=8, impl="auto")),
+        ("pallas_int4", topk_int4_compressor(chunk=512, k=8, impl="auto")),
         ("jnp_reference", topk_int8_compressor(ratio=8 / 512, chunk=512)),
     ]:
         roundtrip = jax.jit(lambda v, c=comp: c.decompress(c.compress(v)))
