@@ -89,6 +89,18 @@ _SLOW_TESTS = {
     # elastic resize (each builds + trains a stacked state first)
     "test_training_continues_after_resize_both_ways",
     "test_resize_resets_choco_state_at_new_world",
+    "test_grow_joiners_start_at_consensus_mean",
+    "test_shrink_keeps_survivor_replicas_exactly",
+    # round-2 additions measured >=5s (2026-07-30 re-tier)
+    "test_resnet_fused_impl_matches_flax_impl",
+    "test_sequence_parallel_training_end_to_end",
+    "test_collective_matches_simulated_hierarchical",
+    "test_gpt2_causality",
+    "test_odd_sizes_and_padding",
+    "test_zero_lr_reduces_to_plain_gossip",
+    "test_mean_model_at_consensus_equals_workers",
+    "test_cli_profile_dir",
+    "test_gpt2_fullseq_forward_uses_blockwise_without_oom",
     # two-controller jax.distributed run (subprocess pair + compiles)
     "test_two_process_collective_training",
     "test_two_process_checkpoint_and_eval",
