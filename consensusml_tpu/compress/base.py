@@ -235,7 +235,9 @@ class ComposedCompressor(Compressor):
 
     Reference parity: "top-k sparsified + 8-bit quantized gradient gossip"
     (BASELINE.json configs[4]). The outer codec is applied to the inner
-    payload's ``values`` leaf only (indices stay exact int32).
+    payload's ``values`` leaf only; indices stay exact — int32 global for
+    :class:`TopKPayload`, uint16 chunk-local for :class:`LocalTopKPayload`
+    (the ``narrow_indices`` default of ``ChunkedTopKCompressor``).
     """
 
     inner: Compressor  # produces a TopKPayload or LocalTopKPayload

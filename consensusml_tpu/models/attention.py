@@ -36,6 +36,7 @@ def dot_product_attention(
     *,
     causal: bool = False,
     bias: jax.Array | None = None,
+    kv_mask: jax.Array | None = None,
     dtype: Any = jnp.bfloat16,
     impl: str = "auto",
 ) -> jax.Array:
@@ -48,10 +49,27 @@ def dot_product_attention(
     (:mod:`consensusml_tpu.models.flash_attention` — measured ~1.9x
     dense and ~2.5x blockwise fwd+bwd on a v5e at seq 2048); "auto"
     picks, once S*T crosses the dense threshold, flash on TPU when the
-    kernel's contract holds (self-attention shapes, no bias) and
+    kernel's contract holds (self-attention shapes, no full bias) and
     blockwise otherwise. All paths share the recipe: logits accumulate
     in f32 on the MXU, softmax in f32, output in ``dtype``.
+
+    ``kv_mask`` ((B, T), nonzero = attend) is the per-key padding mask —
+    BERT's attention_mask. Unlike a general additive ``bias`` it rides
+    the flash kernel (one f32 row per batch); on the other impls it is
+    folded into the bias. Pass at most one of ``bias``/``kv_mask`` for a
+    padding mask; arbitrary score biases still need ``bias``.
     """
+    if kv_mask is not None:
+        if bias is not None:
+            raise ValueError(
+                "pass either bias or kv_mask, not both (fold the padding "
+                "mask into your bias, or drop the bias)"
+            )
+        if kv_mask.shape != (k.shape[0], k.shape[1]):
+            raise ValueError(
+                f"kv_mask must be (batch, kv_len) = "
+                f"{(k.shape[0], k.shape[1])}, got {kv_mask.shape}"
+            )
     if impl == "auto":
         if q.shape[1] * k.shape[1] <= _BLOCKWISE_THRESHOLD:
             impl = "dense"
@@ -67,11 +85,16 @@ def dot_product_attention(
         if bias is not None:
             raise ValueError(
                 "impl='flash' does not support bias (the Pallas kernel has "
-                "no bias input); use impl='blockwise' or 'auto'"
+                "no bias input; a padding mask can ride kv_mask instead); "
+                "use impl='blockwise' or 'auto'"
             )
         from consensusml_tpu.models.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, dtype=dtype)
+        return flash_attention(
+            q, k, v, causal=causal, kv_mask=kv_mask, dtype=dtype
+        )
+    if kv_mask is not None:  # non-flash impls take it as an additive bias
+        bias = jnp.where(kv_mask[:, None, None, :] > 0, 0.0, _NEG_INF)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal, bias=bias, dtype=dtype)
     if impl != "dense":

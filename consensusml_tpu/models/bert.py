@@ -42,12 +42,17 @@ class _EncoderLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask_bias, deterministic: bool):
+    def __call__(self, x, kv_mask, deterministic: bool):
         c = self.config
         d_head = c.hidden // c.heads
         qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        attn = dot_product_attention(q, k, v, bias=mask_bias, dtype=c.dtype)
+        # the padding mask travels as a per-key row, NOT a (B,H,S,T) bias:
+        # the form the Pallas flash kernel accepts, so padded encoder runs
+        # keep kernel eligibility at long sequences (the config-3 shape,
+        # seq 128, stays on the dense path by the auto threshold — dense
+        # IS the fastest impl there; the kernel takes over past ~512)
+        attn = dot_product_attention(q, k, v, kv_mask=kv_mask, dtype=c.dtype)
         attn = nn.DenseGeneral(c.hidden, axis=(-2, -1), dtype=c.dtype, name="out")(attn)
         attn = nn.Dropout(c.dropout, deterministic=deterministic)(attn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
@@ -89,12 +94,10 @@ class BertMLM(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
         x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
 
-        if attention_mask is None:
-            bias = None
-        else:
-            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
         for i in range(c.layers):
-            x = _EncoderLayer(c, name=f"layer_{i}")(x, bias, deterministic)
+            x = _EncoderLayer(c, name=f"layer_{i}")(
+                x, attention_mask, deterministic
+            )
 
         # MLM transform head + tied decoder
         x = nn.Dense(c.hidden, dtype=c.dtype, name="mlm_dense")(x)

@@ -315,8 +315,11 @@ def fused_batch_norm(
     Returns ``(y, mean, var)`` with ``mean``/``var`` the f32 batch
     statistics (biased variance, flax ``use_fast_variance`` semantics).
     Gradients flow through the statistics into ``x`` exactly as in
-    standard BN; the ``mean``/``var`` *outputs* themselves carry no
-    gradient (mutable-state convention — stop-gradient them if stored).
+    standard BN; the ``mean``/``var`` *outputs* are returned behind
+    ``stop_gradient`` (mutable-state convention, made structural: the
+    custom VJP drops their cotangents, so exposing grad-carrying outputs
+    would silently differentiate to zero — a loss term on the returned
+    statistics now raises/propagates nothing by construction instead).
 
     ``act``: ``None`` or ``"relu"`` (fused into the normalize pass and
     its backward mask). ``impl``: ``auto`` | ``pallas`` | ``jnp`` |
@@ -331,7 +334,13 @@ def fused_batch_norm(
     y, mean, var = _bn_train_vjp(
         x2, gamma, beta, eps, act == "relu", impl, pack_small
     )
-    return y.reshape(x.shape), mean, var
+    # structural: the VJP ignores stats cotangents, so make the outputs
+    # visibly non-differentiable rather than silently zero-gradient
+    return (
+        y.reshape(x.shape),
+        jax.lax.stop_gradient(mean),
+        jax.lax.stop_gradient(var),
+    )
 
 
 # ---------------------------------------------------------------------------

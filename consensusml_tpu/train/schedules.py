@@ -14,6 +14,7 @@ schedule exactly where it left off.
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Union
 
 import optax
@@ -90,10 +91,20 @@ def build_optimizer(
     Plain factories (e.g. ``optax.sgd``) get the clip chained outside.
     """
     sched = lr_schedule(kind, peak_lr, total_steps, warmup_steps)
+    # detect grad_clip support by signature, NOT try/except TypeError: an
+    # internal TypeError from a clip-aware factory must propagate, never
+    # silently fall back to clipping outside the factory's param mask
     try:
+        sig = inspect.signature(factory)
+        accepts_clip = "grad_clip" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        )
+    except (TypeError, ValueError):  # C callables without a signature
+        accepts_clip = False
+    if accepts_clip:
         return factory(sched, grad_clip=grad_clip)
-    except TypeError:
-        tx = factory(sched)
-        if grad_clip > 0:
-            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
-        return tx
+    tx = factory(sched)
+    if grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx

@@ -10,6 +10,7 @@ from consensusml_tpu.utils.checkpoint import (  # noqa: F401
 )
 from consensusml_tpu.utils.elastic import resize_state  # noqa: F401
 from consensusml_tpu.utils.logging import MetricsLogger  # noqa: F401
+from consensusml_tpu.utils.watchdog import ProgressWatchdog  # noqa: F401
 from consensusml_tpu.utils.profiling import (  # noqa: F401
     RoundStats,
     RoundTimer,

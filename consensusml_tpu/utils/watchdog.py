@@ -1,0 +1,101 @@
+"""Bounded-time failure detection for multi-process training.
+
+Reference parity: SURVEY.md §5 failure detection. When a peer process
+dies mid-round, the survivors' next cross-process collective never
+completes — gloo/ICI sends simply wait for a participant that is gone,
+wedging the process inside a C++ call that Python exception handling
+cannot reach. The watchdog guarantees a BOUNDED exit anyway: a daemon
+thread watches a heartbeat the training loop taps once per round, and if
+no beat lands within the timeout it prints a reasoned diagnostic and
+hard-exits (``os._exit`` — the main thread is unrecoverable by
+construction, so interpreter cleanup must be skipped).
+
+Enabled via ``train.py --round-timeout SECONDS``. Pick a timeout well
+above one round's wall time INCLUDING the first round's XLA compile, or
+start the clock late with ``arm_on_first_beat=True`` (train.py does: the
+watchdog only arms once one full round has completed, so compile time
+never counts against the budget).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+__all__ = ["ProgressWatchdog"]
+
+
+class ProgressWatchdog:
+    """Hard-exit the process if :meth:`beat` stops arriving.
+
+    ``beat(tag)`` is called by the owner after every unit of progress;
+    the monitor thread fires when ``timeout_s`` elapses without one and
+    exits the process with ``exit_code`` (distinct from normal failure
+    exits so launchers can tell "peer loss" from "bad config").
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        label: str = "train round",
+        exit_code: int = 3,
+        arm_on_first_beat: bool = True,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.label = label
+        self.exit_code = exit_code
+        self._armed = not arm_on_first_beat
+        self._last = time.monotonic()
+        self._tag: object = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ProgressWatchdog":
+        self._last = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="progress-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def beat(self, tag: object = None) -> None:
+        """Record progress (cheap: two attribute stores, no locking —
+        monotonic staleness is the only thing the monitor reads)."""
+        self._last = time.monotonic()
+        self._tag = tag
+        self._armed = True
+
+    def pause(self) -> None:
+        """Suspend deadline enforcement until the next :meth:`beat` —
+        for phases with a legitimately unbounded first cost (a periodic
+        eval's XLA compile) that must not read as a dead peer. The clock
+        restarts from the resuming beat."""
+        self._armed = False
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- monitor thread ----------------------------------------------------
+    def _run(self) -> None:
+        poll = min(1.0, self.timeout_s / 4)
+        while not self._stop.wait(poll):
+            if not self._armed:
+                self._last = time.monotonic()  # clock starts at first beat
+                continue
+            stalled = time.monotonic() - self._last
+            if stalled > self.timeout_s:
+                print(
+                    f"watchdog: no {self.label} progress for "
+                    f"{stalled:.0f}s (timeout {self.timeout_s:.0f}s, last "
+                    f"progress: {self._tag}); a peer process has likely "
+                    "died mid-collective — exiting so the launcher can "
+                    "reschedule (see consensusml_tpu.utils.watchdog)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.stderr.flush()
+                os._exit(self.exit_code)

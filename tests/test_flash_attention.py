@@ -96,3 +96,87 @@ def test_non_dividing_blocks_pad_to_common_multiple(monkeypatch):
     want = dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense")
     got = flash_attention(q, k, v, causal=True, dtype=jnp.float32, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [128, 100])
+def test_flash_kv_mask_matches_dense_bias(causal, s):
+    """Per-key padding mask (the BERT attention_mask form) against the
+    dense path's additive-bias formulation, fwd + grads."""
+    rng = np.random.default_rng(6)
+    q, k, v = _qkv(rng, 2, s, 2, 64)
+    # ragged "sequence lengths" incl. one full row: 1=attend, 0=padding
+    kv_mask = jnp.asarray(
+        np.stack([np.arange(s) < s, np.arange(s) < (3 * s // 5)]), jnp.float32
+    )
+    bias = jnp.where(kv_mask[:, None, None, :] > 0, 0.0, -1e30)
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, kv_mask=kv_mask, dtype=jnp.float32,
+            interpret=True,
+        )
+        return jnp.sum(o**2), o
+
+    def dense_loss(q, k, v):
+        o = dot_product_attention(
+            q, k, v, causal=causal, bias=bias, dtype=jnp.float32, impl="dense"
+        )
+        return jnp.sum(o**2), o
+
+    (_, got), gf = jax.value_and_grad(flash_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, want), gd = jax.value_and_grad(dense_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_kv_mask_batch_rows_are_independent():
+    """The (b // heads) index map must hand each batch its OWN mask row —
+    a batch-0-only bug would be invisible to single-batch parity tests."""
+    rng = np.random.default_rng(7)
+    b, s, h, d = 3, 64, 2, 64
+    q, k, v = _qkv(rng, b, s, h, d)
+    lens = [64, 40, 17]
+    kv_mask = jnp.asarray(
+        np.stack([np.arange(s) < n for n in lens]), jnp.float32
+    )
+    got = flash_attention(
+        q, k, v, kv_mask=kv_mask, dtype=jnp.float32, interpret=True
+    )
+    for i, n in enumerate(lens):
+        # each batch row must equal its OWN single-batch masked attention
+        want = flash_attention(
+            q[i : i + 1], k[i : i + 1], v[i : i + 1],
+            kv_mask=kv_mask[i : i + 1], dtype=jnp.float32, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[i]), np.asarray(want[0]), rtol=2e-5, atol=2e-5,
+            err_msg=f"batch {i} (len {n})",
+        )
+
+
+def test_dot_product_attention_kv_mask_across_impls():
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, 2, 96, 2, 64)
+    kv_mask = jnp.asarray(
+        np.stack([np.arange(96) < 70, np.arange(96) < 33]), jnp.float32
+    )
+    dense = dot_product_attention(
+        q, k, v, kv_mask=kv_mask, dtype=jnp.float32, impl="dense"
+    )
+    blk = dot_product_attention(
+        q, k, v, kv_mask=kv_mask, dtype=jnp.float32, impl="blockwise"
+    )
+    np.testing.assert_allclose(
+        np.asarray(blk), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+    with pytest.raises(ValueError, match="not both"):
+        dot_product_attention(
+            q, k, v, kv_mask=kv_mask, bias=jnp.zeros((2, 1, 1, 96))
+        )
+    with pytest.raises(ValueError, match="kv_mask must be"):
+        dot_product_attention(q, k, v, kv_mask=kv_mask[:, :10])

@@ -5,16 +5,23 @@ worker.py processes — separate JAX controllers, 4 virtual CPU devices
 each — that rendezvous through jax.distributed and train the 8-worker
 ring config collectively: gossip ppermutes cross the process boundary
 through gloo exactly as they cross hosts through DCN on a pod.
+
+Failure paths (VERDICT r2 item 9): the happy path is not what worker.py
+meets on a pod. Mismatched ``--num-processes`` and an already-bound
+coordinator port are rejected FAST by the pre-rendezvous handshake
+(before any jax import), and a peer killed mid-run trips the survivor's
+``--round-timeout`` watchdog within a bounded time instead of wedging in
+a dead collective forever.
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
-
-pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -51,6 +58,7 @@ def _launch(extra):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_collective_training():
     outs = _launch(["--rounds", "3"])
     for rc, out in outs:
@@ -65,6 +73,7 @@ def test_two_process_collective_training():
     assert final[0] == final[1], final
 
 
+@pytest.mark.slow
 def test_two_process_checkpoint_and_eval(tmp_path):
     """The aux paths that once assumed fully-addressable arrays: orbax
     checkpoint of a cross-process-sharded state, and held-out eval whose
@@ -75,3 +84,124 @@ def test_two_process_checkpoint_and_eval(tmp_path):
         assert rc == 0, out[-1500:]
         assert "eval[mean-model]" in out
     assert os.path.exists(os.path.join(ck, "step_2", "cml_meta.json"))
+
+
+def _worker_cmd(port, pid, num, extra_worker=(), train=()):
+    return [
+        sys.executable, os.path.join(REPO, "worker.py"),
+        "--coordinator", f"127.0.0.1:{port}",
+        "--num-processes", str(num), "--process-id", str(pid),
+        "--local-devices", "4", *extra_worker, "--",
+        "--config", "cifar_resnet50", "--device", "cpu",
+        "--backend", "collective", *train,
+    ]
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = ""
+    return env
+
+
+def test_mismatched_num_processes_rejected_fast():
+    """Disagreeing --num-processes must fail in seconds with a reasoned
+    message, not hang both processes to the grpc barrier timeout."""
+    port = _free_port()
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            _worker_cmd(port, pid, num, ["--rendezvous-timeout", "60"]),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=_clean_env(),
+        )
+        # process 0 expects a 3-process world; process 1 a 2-process one
+        for pid, num in ((0, 3), (1, 2))
+    ]
+    outs = [p.communicate(timeout=90)[0] for p in procs]
+    elapsed = time.monotonic() - t0
+    # the mismatch is detected on first contact, well under the timeout
+    assert elapsed < 60, f"took {elapsed:.0f}s — rejection was not fast"
+    for p, out in zip(procs, outs):
+        assert p.returncode != 0, out[-800:]
+        assert "mismatched --num-processes" in out, out[-800:]
+
+
+def test_bound_coordinator_port_rejected_fast():
+    """A coordinator port someone else owns must fail process 0
+    immediately with a pointer at the cause, not hang."""
+    squatter = socket.socket()
+    squatter.bind(("127.0.0.1", 0))
+    squatter.listen(1)
+    port = squatter.getsockname()[1]
+    try:
+        proc = subprocess.run(
+            _worker_cmd(port, 0, 2, ["--rendezvous-timeout", "30"]),
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=_clean_env(),
+        )
+    finally:
+        squatter.close()
+    assert proc.returncode != 0
+    combined = proc.stdout + proc.stderr
+    assert "unavailable" in combined and "--coordinator" in combined, (
+        combined[-800:]
+    )
+
+
+@pytest.mark.slow
+def test_peer_death_detected_within_bound():
+    """Kill one process mid-run: the survivor must exit with a clean
+    diagnostic inside a bounded time (the --round-timeout watchdog; a
+    dead peer otherwise wedges the next gossip collective forever)."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            _worker_cmd(
+                port, pid, 2,
+                train=["--rounds", "500", "--round-timeout", "15",
+                       "--log-every", "1"],
+            ),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=_clean_env(),
+        )
+        for pid in range(2)
+    ]
+    survivor, victim = procs
+    # drain the victim's pipe so a full stdout buffer can never stall its
+    # training loop (which would deadlock the survivor's collectives)
+    import threading
+
+    threading.Thread(
+        target=lambda: victim.stdout.read(), daemon=True
+    ).start()
+    try:
+        # wait until the survivor has completed at least one round (the
+        # watchdog arms on the first beat, so compile time never counts)
+        deadline = time.monotonic() + 300
+        saw_round = False
+        lines = []
+        while time.monotonic() < deadline:
+            line = survivor.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line.startswith("[round"):
+                saw_round = True
+                break
+        assert saw_round, "".join(lines)[-1500:]
+        victim.send_signal(signal.SIGKILL)
+        t0 = time.monotonic()
+        rest, _ = survivor.communicate(timeout=240)
+        detected_s = time.monotonic() - t0
+        out = "".join(lines) + rest
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+    assert survivor.returncode != 0, out[-1500:]
+    # the watchdog prints a reasoned diagnostic and uses its own exit code
+    assert "watchdog: no train round progress" in out, out[-1500:]
+    assert survivor.returncode == 3, survivor.returncode
+    # bounded: 15s timeout + poll granularity + fetch slack, not 540s
+    assert detected_s < 120, f"took {detected_s:.0f}s to detect peer death"

@@ -1,14 +1,26 @@
 """Convergence comparison across the gossip modes (docs/convergence.md).
 
-Same workload, same seeds, same data order for every variant: 8-worker
-MLP classification (the mnist_mlp shape), h=2 local steps, ring-family
-topologies, simulated backend on CPU. Reports final loss, consensus
-error, and held-out top-1 of the consensus (mean) model — the apparatus
-behind the north star's "identical convergence" clause: any two modes
-can be compared on equal footing, and the numbers in docs/convergence.md
-were produced by exactly this script.
+Same workload, same seeds, same data order for every variant; simulated
+backend so every mode shares one device's arithmetic. Two workloads:
 
-Usage:  python tools/convergence_study.py [--rounds N] [--md]
+- ``--workload mlp`` — 8-worker MLP (the mnist_mlp shape), h=2, CPU. The
+  quick smoke matrix; its task is easy enough that top-1 saturates, so
+  only loss/consensus-error discriminate.
+- ``--workload resnet`` — ResNet-50 with the CIFAR stem on 32x32x3
+  synthetic data whose noise floor is tuned so held-out top-1 lands in
+  the 0.7-0.9 band: hard enough that the accuracy column *could*
+  separate the gossip modes. This is the apparatus behind the north
+  star's "at matching top-1 accuracy" clause (BASELINE.json): if a codec
+  or topology hurt convergence, it would show here as a top-1 gap.
+
+Sweep axes (either workload): ``--h-sweep`` runs exact + CHOCO at
+H ∈ {1, 2, 8} (config 3's recipe is H=8 periodic averaging), and
+``--gamma-sweep`` runs CHOCO int8 across gamma to show the consensus
+floor is controllable (VERDICT r2 items 1 and 4).
+
+Usage:
+  python tools/convergence_study.py --workload resnet --rounds 300 \
+      --h-sweep --gamma-sweep --md --out /tmp/study.json
 """
 
 from __future__ import annotations
@@ -22,13 +34,73 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-WORLD, H, BATCH, HIDDEN = 8, 2, 16, 32
+GAMMAS = (0.1, 0.3, 0.5, 0.8, 1.0)
+H_SWEEP = (1, 2, 8)
 
 
-def variants():
-    import optax
+def build_workload(name: str, noise: float | None, batch: int | None):
+    """Model/loss/eval/data factory shared by every variant of a run."""
+    import jax.numpy as jnp
 
-    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.data import SyntheticClassification
+    from consensusml_tpu.train import classification_eval_fn
+
+    if name == "mlp":
+        from consensusml_tpu.models import MLP, mlp_loss_fn
+
+        model = MLP(hidden=32)
+        # noise high enough that the Bayes rate is < 1: an all-1.0 table
+        # would say nothing about the modes' relative convergence
+        data = SyntheticClassification(
+            n=2048, image_shape=(28, 28, 1), noise=3.0 if noise is None else noise
+        )
+        return {
+            "world": 8,
+            "h": 2,
+            "batch": batch or 16,
+            "loss_fn": mlp_loss_fn(model),
+            "init": lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
+            "eval_fn": classification_eval_fn(model),
+            "data": data,
+            "opt": lambda: __import__("optax").sgd(0.05),
+            "scale": 1.0,
+            "holdout": 512,
+            "eval_batch": 64,
+        }
+    if name == "resnet":
+        from consensusml_tpu.models import resnet50, resnet_init, resnet_loss_fn
+
+        model = resnet50(num_classes=10, stem="cifar")
+        noise = 12.0 if noise is None else noise
+        data = SyntheticClassification(
+            n=8192, image_shape=(32, 32, 3), noise=noise
+        )
+        return {
+            "world": 8,
+            "h": 2,
+            "batch": batch or 16,
+            "loss_fn": resnet_loss_fn(model),
+            "init": resnet_init(model, (1, 32, 32, 3)),
+            "eval_fn": classification_eval_fn(model, train_kwarg=True),
+            "data": data,
+            "opt": lambda: __import__("optax").sgd(0.05, momentum=0.9),
+            # raw inputs have std ~= noise; a uniform rescale keeps the
+            # task identical but the conv stem numerically comfortable
+            "scale": 1.0 / (1.0 + noise),
+            "holdout": 1024,
+            "eval_batch": 128,
+        }
+    raise ValueError(f"unknown workload {name!r}")
+
+
+def variants(wl, args):
+    import optax  # noqa: F401  (opt factories resolve it lazily)
+
+    from consensusml_tpu.compress import (
+        QSGD4Compressor,
+        topk_int4_compressor,
+        topk_int8_compressor,
+    )
     from consensusml_tpu.consensus import GossipConfig
     from consensusml_tpu.topology import (
         OnePeerExponentialTopology,
@@ -36,82 +108,104 @@ def variants():
     )
     from consensusml_tpu.train import LocalSGDConfig, SlowMoConfig
 
-    ring = RingTopology(WORLD)
-    tx = lambda: optax.sgd(0.05)
-    return {
+    world, h, tx = wl["world"], wl["h"], wl["opt"]
+    ring = RingTopology(world)
+    choco = lambda comp, gamma=0.5, hh=h: LocalSGDConfig(  # noqa: E731
+        gossip=GossipConfig(topology=ring, compressor=comp, gamma=gamma),
+        optimizer=tx(),
+        h=hh,
+    )
+    out = {
         "exact ring": LocalSGDConfig(
-            gossip=GossipConfig(topology=ring), optimizer=tx(), h=H
+            gossip=GossipConfig(topology=ring), optimizer=tx(), h=h
         ),
         "overlap ring": LocalSGDConfig(
-            gossip=GossipConfig(topology=ring, overlap=True), optimizer=tx(), h=H
+            gossip=GossipConfig(topology=ring, overlap=True), optimizer=tx(), h=h
         ),
-        "choco topk+int8": LocalSGDConfig(
-            gossip=GossipConfig(
-                topology=ring,
-                compressor=topk_int8_compressor(ratio=0.1, chunk=128),
-                gamma=0.5,
-            ),
-            optimizer=tx(),
-            h=H,
-        ),
+        "choco topk+int8": choco(topk_int8_compressor(ratio=0.1, chunk=128)),
+        "choco topk+int4": choco(topk_int4_compressor(ratio=0.1, chunk=128)),
+        "choco qsgd4": choco(QSGD4Compressor(chunk=128)),
         "push-sum one-peer (directed)": LocalSGDConfig(
             gossip=GossipConfig(
-                topology=OnePeerExponentialTopology(WORLD), push_sum=True
+                topology=OnePeerExponentialTopology(world), push_sum=True
             ),
             optimizer=tx(),
-            h=H,
+            h=h,
         ),
         "exact ring + SlowMo": LocalSGDConfig(
             gossip=GossipConfig(topology=ring),
             optimizer=tx(),
-            h=H,
+            h=h,
             outer=SlowMoConfig(beta=0.5),
         ),
     }
+    if args.h_sweep:
+        for hh in H_SWEEP:
+            if hh == h:
+                continue  # the base rows already cover the default H
+            out[f"exact ring h={hh}"] = LocalSGDConfig(
+                gossip=GossipConfig(topology=ring), optimizer=tx(), h=hh
+            )
+            out[f"choco topk+int8 h={hh}"] = choco(
+                topk_int8_compressor(ratio=0.1, chunk=128), hh=hh
+            )
+    if args.gamma_sweep:
+        for g in GAMMAS:
+            if g == 0.5:
+                continue  # == the base "choco topk+int8" row
+            out[f"choco topk+int8 gamma={g}"] = choco(
+                topk_int8_compressor(ratio=0.1, chunk=128), gamma=g
+            )
+    if args.modes:
+        keep = [m.strip() for m in args.modes.split(",")]
+        exact = {k: v for k, v in out.items() if k in keep}
+        # exact names win ("exact ring" should not drag in "+ SlowMo");
+        # substrings only for filters that name no row exactly
+        out = exact or {
+            k: v for k, v in out.items() if any(s in k for s in keep)
+        }
+    return out
 
 
-def run_variant(cfg, rounds: int) -> dict:
+def run_variant(cfg, wl, rounds: int) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from consensusml_tpu.data import SyntheticClassification, round_batches
-    from consensusml_tpu.models import MLP, mlp_loss_fn
+    from consensusml_tpu.data import round_batches
     from consensusml_tpu.train import (
-        classification_eval_fn,
         evaluate,
         init_stacked_state,
         make_simulated_train_step,
     )
 
-    model = MLP(hidden=HIDDEN)
-    step = make_simulated_train_step(cfg, mlp_loss_fn(model))
-    state = init_stacked_state(
-        cfg,
-        lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
-        jax.random.key(0),
-        WORLD,
-    )
-    # noise high enough that the Bayes rate is < 1: an all-1.0 table
-    # would say nothing about the modes' relative convergence
-    data = SyntheticClassification(n=2048, image_shape=(28, 28, 1), noise=3.0)
+    world, scale = wl["world"], wl["scale"]
+    step = make_simulated_train_step(cfg, wl["loss_fn"])
+    state = init_stacked_state(cfg, wl["init"], jax.random.key(0), world)
+    # equal tokens-seen across the h-sweep: fewer rounds at larger H so
+    # every row consumes the same number of microbatches
+    n_rounds = max(1, (rounds * wl["h"]) // cfg.h)
     losses, errs = [], []
-    for batch in round_batches(data, WORLD, cfg.h, BATCH, rounds):
+    for batch in round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds):
+        if scale != 1.0:
+            batch = dict(batch, image=batch["image"] * scale)
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
         errs.append(float(m["consensus_error"]))
 
-    held = data.holdout(512)
+    held = wl["data"].holdout(wl["holdout"])
+    eb = wl["eval_batch"]
 
-    def eval_batches(n_batches):
-        for r in range(n_batches):
+    def eval_batches():
+        for r in range(wl["holdout"] // eb):
             yield {
-                "image": jnp.asarray(held.images[r * 64 : (r + 1) * 64]),
-                "label": jnp.asarray(held.labels[r * 64 : (r + 1) * 64]),
+                "image": jnp.asarray(held.images[r * eb : (r + 1) * eb]) * scale,
+                "label": jnp.asarray(held.labels[r * eb : (r + 1) * eb]),
             }
 
-    ev = evaluate(classification_eval_fn(model), state, eval_batches(8))
+    ev = evaluate(wl["eval_fn"], state, eval_batches())
     return {
+        "rounds": n_rounds,
         "final_loss": round(float(np.mean(losses[-5:])), 4),
         "consensus_error": round(errs[-1], 4),
         "top1_consensus_model": round(float(ev["mean_model"]["top1"]), 4),
@@ -121,29 +215,56 @@ def run_variant(cfg, rounds: int) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", choices=("mlp", "resnet"), default="mlp")
     ap.add_argument("--rounds", type=int, default=80)
+    ap.add_argument("--noise", type=float, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--h-sweep", action="store_true")
+    ap.add_argument("--gamma-sweep", action="store_true")
+    ap.add_argument("--modes", default=None, help="comma substrings to keep")
+    ap.add_argument(
+        "--device",
+        choices=("cpu", "tpu"),
+        default=None,
+        help="default: cpu for mlp, accelerator (if present) for resnet",
+    )
     ap.add_argument("--md", action="store_true", help="print a markdown table")
+    ap.add_argument("--out", default=None, help="also write results JSON here")
     args = ap.parse_args()
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    device = args.device or ("cpu" if args.workload == "mlp" else "tpu")
+    if device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
+    wl = build_workload(args.workload, args.noise, args.batch)
     rows = {}
-    for name, cfg in variants().items():
-        rows[name] = run_variant(cfg, args.rounds)
+    for name, cfg in variants(wl, args).items():
+        rows[name] = run_variant(cfg, wl, args.rounds)
         print(f"# {name}: {json.dumps(rows[name])}", file=sys.stderr, flush=True)
+
+    if args.out:
+        meta = {
+            "workload": args.workload,
+            "rounds": args.rounds,
+            "noise": args.noise,
+            "backend": jax.default_backend(),
+        }
+        with open(args.out, "w") as f:
+            json.dump({"meta": meta, "rows": rows}, f, indent=2)
 
     if args.md:
         print(
-            "| mode | final loss | consensus error | top-1 (consensus model)"
-            " | top-1 (worker mean) |"
+            "| mode | rounds | final loss | consensus error |"
+            " top-1 (consensus model) | top-1 (worker mean) |"
         )
-        print("|---|---|---|---|---|")
+        print("|---|---|---|---|---|---|")
         for name, r in rows.items():
             print(
-                f"| {name} | {r['final_loss']} | {r['consensus_error']} "
-                f"| {r['top1_consensus_model']} | {r['top1_worker_mean']} |"
+                f"| {name} | {r['rounds']} | {r['final_loss']} "
+                f"| {r['consensus_error']} | {r['top1_consensus_model']} "
+                f"| {r['top1_worker_mean']} |"
             )
     else:
         print(json.dumps(rows, indent=2))

@@ -89,6 +89,13 @@ def parse_args(argv=None):
                    help="linear LR warmup, in gossip rounds")
     p.add_argument("--grad-clip", type=float, default=0.0,
                    help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--round-timeout", type=float, default=0.0,
+                   help="seconds without round progress before the process "
+                        "hard-exits with a diagnostic (failure detection for "
+                        "multi-process runs: a dead peer wedges survivors "
+                        "inside a collective forever otherwise); arms after "
+                        "the first completed round so XLA compile never "
+                        "counts; 0 = disabled")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
@@ -313,11 +320,20 @@ def main(argv=None) -> int:
             "topk_int8": topk_int8_compressor,
             "topk_int4": topk_int4_compressor,
         }[args.codec]
-        comp = (
-            make(chunk=512, k=8, impl="auto")
-            if scale == "full"
-            else make(ratio=0.1, chunk=128, impl="auto")
-        )
+        # preserve the config's sparsity/chunking and change ONLY the
+        # quantizer width: read chunk and k (or ratio) off the current
+        # compressor rather than hardcoding, so a config whose codec
+        # parameters drift keeps them under --codec
+        cur = bundle.cfg.gossip.compressor
+        inner = getattr(cur, "inner", cur)
+        chunk = getattr(inner, "chunk", 512 if scale == "full" else 128)
+        k = getattr(inner, "k_per_chunk", None) or getattr(inner, "k", None)
+        if k is not None:
+            comp = make(chunk=chunk, k=k, impl="auto")
+        else:
+            comp = make(
+                ratio=getattr(inner, "ratio", 0.1), chunk=chunk, impl="auto"
+            )
         bundle.cfg = dataclasses.replace(
             bundle.cfg,
             gossip=dataclasses.replace(bundle.cfg.gossip, compressor=comp),
@@ -566,6 +582,13 @@ def main(argv=None) -> int:
             )
             return 2
         batch_source = bundle.native_batches
+    watchdog = None
+    if args.round_timeout > 0:
+        from consensusml_tpu.utils import ProgressWatchdog
+
+        watchdog = ProgressWatchdog(
+            args.round_timeout, label="train round"
+        ).start()
     batch_shardings = None
     for i, batch in enumerate(batch_source(args.rounds, args.seed, start)):
         rnd = start + i
@@ -584,7 +607,9 @@ def main(argv=None) -> int:
             profiling.__exit__(None, None, None)
             profiling = contextlib.nullcontext()
             print(f"profile trace: {args.profile_dir}", flush=True)
-        logger.log(rnd, metrics)
+        logger.log(rnd, metrics)  # float() fetches => a real execution fence
+        if watchdog is not None:
+            watchdog.beat(f"round {rnd}")
         if (
             args.eval_every > 0
             and (rnd + 1) % args.eval_every == 0
@@ -593,7 +618,14 @@ def main(argv=None) -> int:
             # the end-of-run eval below covers a final-round boundary
             and rnd + 1 != start + args.rounds
         ):
+            if watchdog is not None:
+                # eval (incl. its first-call XLA compile) has no per-round
+                # budget: suspend enforcement entirely rather than grant
+                # it one round's allowance, and re-arm when it completes
+                watchdog.pause()
             run_eval(state, rnd)
+            if watchdog is not None:
+                watchdog.beat(f"eval done @ round {rnd}")
         if (
             args.checkpoint_dir
             and args.checkpoint_every
@@ -607,6 +639,8 @@ def main(argv=None) -> int:
         print(f"profile trace: {args.profile_dir}", flush=True)
     if args.checkpoint_dir and last_saved != start + args.rounds:
         saver.submit(args.checkpoint_dir, state, step=start + args.rounds)
+    if watchdog is not None:
+        watchdog.stop()
     if args.checkpoint_dir:
         saver.wait()
         print(f"checkpoint: {saver.last_path}", flush=True)

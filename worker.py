@@ -20,7 +20,158 @@ Example (2 hosts):
 from __future__ import annotations
 
 import argparse
+import json
+import socket
 import sys
+import time
+
+
+def _prevalidate_rendezvous(
+    coordinator: str, num_processes: int, process_id: int, timeout: float
+) -> None:
+    """Fail-FAST rendezvous validation (SURVEY.md §5 failure detection).
+
+    ``jax.distributed.initialize`` is an opaque barrier: a mismatched
+    ``--num-processes``, a duplicate ``--process-id``, or a coordinator
+    port owned by a stale run all present as a silent hang until the grpc
+    timeout. Before that barrier, process 0 briefly listens on the SAME
+    coordinator port (so no second port needs opening) and every peer
+    sends its ``(num_processes, process_id)``; disagreements are rejected
+    with a reasoned message in one round-trip. The socket closes before
+    jax's coordinator service binds the port; peers' grpc clients retry
+    until it comes up, so the happy path is unchanged.
+    """
+    host, port_s = coordinator.rsplit(":", 1)
+    port = int(port_s)
+    deadline = time.monotonic() + timeout
+
+    def fail(msg: str) -> None:
+        raise SystemExit(f"worker {process_id}: {msg}")
+
+    if process_id == 0:
+        try:
+            srv = socket.socket()
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            # all interfaces, like jax's coordinator service: the
+            # --coordinator host may be a service/NAT address that resolves
+            # to this machine without being a local interface IP
+            srv.bind(("", port))
+        except OSError as e:
+            import errno
+
+            why = (
+                "another run (or a stale coordinator) already owns it; "
+                "pick a different --coordinator port"
+                if e.errno in (errno.EADDRINUSE, errno.EACCES)
+                else "check the port number and host permissions"
+            )
+            fail(f"coordinator port {port} is unavailable ({e}) — {why}")
+        srv.listen(num_processes)
+        srv.settimeout(0.5)
+        seen: dict[int, socket.socket] = {}
+        try:
+            while len(seen) < num_processes - 1:
+                if time.monotonic() > deadline:
+                    fail(
+                        f"rendezvous pre-check timed out after {timeout:.0f}s:"
+                        f" heard from process ids {sorted(seen)} but expected "
+                        f"1..{num_processes - 1} — check that every process "
+                        "was launched with the same --num-processes and "
+                        "--coordinator"
+                    )
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                conn.settimeout(5.0)
+                try:
+                    raw = conn.recv(256)
+                    msg = json.loads(raw.decode()) if raw else None
+                except (OSError, ValueError):
+                    msg = None
+                peer_n, peer_id = (
+                    (msg.get("num_processes"), msg.get("process_id"))
+                    if isinstance(msg, dict)
+                    else (None, None)
+                )
+                if not (isinstance(peer_n, int) and isinstance(peer_id, int)):
+                    conn.close()
+                    continue  # stray connection (health probe, port scan)
+                err = None
+                if peer_n != num_processes:
+                    err = (
+                        f"mismatched --num-processes: process {peer_id} was "
+                        f"launched with {peer_n}, process 0 with {num_processes}"
+                    )
+                elif peer_id in seen or not 0 < peer_id < num_processes:
+                    err = (
+                        f"invalid or duplicate --process-id {peer_id} "
+                        f"(world size {num_processes})"
+                    )
+                if err is not None:
+                    reply = json.dumps({"ok": False, "error": err}).encode()
+                    for c in (conn, *seen.values()):
+                        try:
+                            c.sendall(reply)
+                            c.close()
+                        except OSError:
+                            pass
+                    fail(err)
+                seen[peer_id] = conn
+            for c in seen.values():
+                try:
+                    c.sendall(b'{"ok": true}')
+                    c.close()
+                except OSError:
+                    # a validated peer died while we waited for the rest;
+                    # proceed — the grpc barrier below will miss it and
+                    # fail within --rendezvous-timeout with its own error
+                    pass
+        finally:
+            srv.close()
+        return
+
+    # peers: connect-retry until the pre-check listener appears
+    while True:
+        try:
+            conn = socket.create_connection((host, port), timeout=2.0)
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                fail(
+                    f"could not reach coordinator {coordinator} within "
+                    f"{timeout:.0f}s — is process 0 running?"
+                )
+            time.sleep(0.25)
+    try:
+        conn.settimeout(max(1.0, deadline - time.monotonic()))
+        conn.sendall(
+            json.dumps(
+                {"num_processes": num_processes, "process_id": process_id}
+            ).encode()
+        )
+        try:
+            resp = json.loads(conn.recv(512).decode() or "{}")
+        except socket.timeout:
+            # the coordinator replies only once ALL peers check in — a
+            # timeout here means somebody else never arrived, not that
+            # this process or the coordinator is broken
+            fail(
+                f"validated with {coordinator} but no verdict within "
+                f"{timeout:.0f}s — the coordinator is still waiting for "
+                f"other processes (world size {num_processes}); check that "
+                "every process was launched with the same --num-processes"
+            )
+        except (OSError, ValueError):
+            fail(
+                f"no validation reply from {coordinator} — the port answers "
+                "but speaks another protocol; a stale coordinator from a "
+                "previous run may still own it"
+            )
+        if not resp.get("ok"):
+            fail(f"rejected at rendezvous: {resp.get('error')}")
+    finally:
+        conn.close()
 
 
 def main(argv=None) -> int:
@@ -28,6 +179,10 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", default=None, help="host:port of process 0")
     p.add_argument("--num-processes", type=int, default=1)
     p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--rendezvous-timeout", type=float, default=120.0,
+                   help="seconds to wait for all processes at rendezvous "
+                        "(both the fail-fast pre-check and the grpc barrier) "
+                        "before exiting with a diagnostic")
     p.add_argument("--local-devices", type=int, default=None,
                    help="CPU simulation: expose this many virtual CPU devices "
                         "per process (sets the XLA host-platform device count "
@@ -50,6 +205,16 @@ def main(argv=None) -> int:
         ).strip()
 
     if args.num_processes > 1:
+        if args.coordinator is not None:
+            # without an explicit coordinator, jax.distributed auto-detects
+            # from the cluster environment (TPU pod / SLURM) — there is no
+            # address for the pre-check to validate against
+            _prevalidate_rendezvous(
+                args.coordinator,
+                args.num_processes,
+                args.process_id,
+                args.rendezvous_timeout,
+            )
         import jax
 
         kwargs = {}
@@ -61,6 +226,7 @@ def main(argv=None) -> int:
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id,
+            initialization_timeout=int(args.rendezvous_timeout),
             **kwargs,
         )
         print(
