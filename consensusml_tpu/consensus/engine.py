@@ -71,6 +71,18 @@ class GossipConfig:
     path_filter: Any = None  # Callable[[tuple], bool] | None
     faults: FaultConfig | None = None  # None => no fault model
     push_sum: bool = False  # ratio consensus (see consensus.pushsum)
+    # Fused codec: run the compressor ONCE over the CONCATENATED gossiped
+    # tree instead of once per leaf. Chunking then spans leaf boundaries,
+    # which changes WHICH elements a chunked top-k picks (same k per 512
+    # contiguous elements, same family) — a codec-semantics switch; both
+    # backends flatten identically and stay cross-validated. Measured at
+    # GPT-2-medium scale on a v5e (bench --_gossip_round): fusion was the
+    # obvious fix for a 223 ms round, but the real cost was XLA's generic
+    # scatter on the receive path (~69 ms x3); with the structured
+    # chunk_scatter Pallas kernel the per-leaf round is 85 ms and fused is
+    # 134 ms — the whole-tree concat/split tax exceeds the launch savings
+    # — so this stays OFF by default and exists for many-tiny-leaf trees.
+    fused_codec: bool = False
     # Overlap gossip (combine-then-adapt): the round becomes
     #   z_{k+1} = z_k + u_k + (W - I) z_k        (u_k = inner-loop updates)
     # i.e. the mixing correction is computed from the PRE-inner params and
@@ -84,6 +96,12 @@ class GossipConfig:
     overlap: bool = False
 
     def __post_init__(self):
+        if self.fused_codec and self.compressor is None:
+            raise NotImplementedError(
+                "fused_codec without a compressor has nothing to fuse: "
+                "exact mixing already runs one collective per leaf with no "
+                "per-leaf kernel launches to amortize"
+            )
         if self.overlap and self.compressor is not None:
             raise NotImplementedError(
                 "overlap + compression is not supported: CHOCO's innovation "
@@ -125,6 +143,38 @@ class GossipConfig:
                 "directed topology without faults, or push_sum=True "
                 "(ratio consensus is mean-exact on any graph)"
             )
+
+
+def _ravel_tree(tree: Any, stacked: bool = False):
+    """Concatenate an f32 tree into one vector (``fused_codec`` boundary).
+
+    ``stacked=True`` keeps a leading worker axis: leaves ``(W, ...)`` fold
+    to ``(W, n)``. Returns ``(vec, unravel)`` with ``unravel`` restoring
+    the exact structure/shapes (dtype is the caller's concern — the
+    engine casts to f32 before and back after, as for per-leaf CHOCO).
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    lead = leaves[0].shape[0] if stacked else None
+    shapes = [x.shape for x in leaves]
+    if stacked:
+        sizes = [x.size // lead for x in leaves]
+        vec = jnp.concatenate([x.reshape(lead, -1) for x in leaves], axis=1)
+    else:
+        sizes = [x.size for x in leaves]
+        vec = jnp.concatenate([x.reshape(-1) for x in leaves])
+    splits = []
+    off = 0
+    for n in sizes[:-1]:
+        off += n
+        splits.append(off)
+
+    def unravel(v: jax.Array) -> Any:
+        parts = jnp.split(v, splits, axis=1 if stacked else 0)
+        return jax.tree.unflatten(
+            treedef, [p.reshape(s) for p, s in zip(parts, shapes)]
+        )
+
+    return vec, unravel
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +234,13 @@ class ConsensusEngine:
             return None
         if self.config.path_filter is not None:
             params, _ = self._select(params)
+        if self.config.fused_codec:
+            # CHOCO state lives FLAT: one (n,) vector per worker (or
+            # (W, n) stacked), matching the fused round's compress domain
+            n = sum(x.size for x in jax.tree.leaves(params))
+            shape = (n,) if world_size is None else (world_size, n // world_size)
+            zeros = jnp.zeros(shape, jnp.float32)
+            return ChocoState(xhat=zeros, s=jnp.copy(zeros))
         zeros = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
         return ChocoState(xhat=zeros, s=jax.tree.map(jnp.copy, zeros))
 
@@ -270,6 +327,11 @@ class ConsensusEngine:
             params, rebuild = self._select(params)
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
+        unravel = None
+        if self.config.fused_codec:
+            # one compress/decompress over the concatenated tree instead
+            # of ~3 kernel launches per leaf (see GossipConfig.fused_codec)
+            x, unravel = _ravel_tree(x)
         delta = jax.tree.map(jnp.subtract, x, state.xhat)
         q = comp.compress_tree(delta, rng)
         dec_q = comp.decompress_tree(q, like=delta)
@@ -290,6 +352,8 @@ class ConsensusEngine:
         x_new = jax.tree.map(
             lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat
         )
+        if unravel is not None:
+            x_new = unravel(x_new)
         x_new = jax.tree.map(
             lambda new, old: new.astype(old.dtype), x_new, params
         )
@@ -396,6 +460,11 @@ class ConsensusEngine:
             params, rebuild = self._select(params)
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
+        unravel = None
+        if self.config.fused_codec:
+            # same flatten boundary as the collective backend: per-worker
+            # rows (W, n), compress vmapped over the worker axis below
+            x, unravel = _ravel_tree(x, stacked=True)
         delta = jax.tree.map(jnp.subtract, x, state.xhat)
         # vmap the SAME compress_tree/decompress_tree path the collective
         # backend runs, so the per-leaf rng fold-in convention has one
@@ -418,6 +487,8 @@ class ConsensusEngine:
         x_new = jax.tree.map(
             lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat
         )
+        if unravel is not None:
+            x_new = unravel(x_new)
         x_new = jax.tree.map(lambda new, old: new.astype(old.dtype), x_new, params)
         if rebuild is not None:
             x_new = rebuild(x_new)
@@ -445,7 +516,13 @@ class ConsensusEngine:
                 return int(np.prod(shape)) * np.dtype(jnp.float32).itemsize
             return comp.wire_bytes(shape, jnp.float32)
 
-        payload = sum(leaf_bytes(x) for x in jax.tree.leaves(params))
+        if comp is not None and self.config.fused_codec:
+            # one payload over the concatenated tree (the fused round's
+            # actual wire), not a per-leaf sum
+            n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+            payload = comp.wire_bytes((n,), jnp.float32)
+        else:
+            payload = sum(leaf_bytes(x) for x in jax.tree.leaves(params))
         topo = self.topology
         if topo.is_time_varying:
             sends = sum(

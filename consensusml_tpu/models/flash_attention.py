@@ -108,7 +108,7 @@ def _fwd_kernel(
         if causal:
             mask = mask & (q_pos >= koff + k_local)
         if has_mask:  # per-key padding mask, one f32 row per batch
-            km = kvm_ref[:, pl.ds(j * bk, bk)] > 0.0  # (1, bk)
+            km = _kvm_row(kvm_ref, j * bk, bk)  # (1, bk)
             mask = mask & jnp.broadcast_to(km, (bq, bk))
         s = jnp.where(mask, s, _NEG_INF)
         m_blk = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
@@ -139,18 +139,32 @@ def _fwd_kernel(
 
 
 def _kvm_spec(kv_mask, sk_pad, heads):
-    """(mask array, its BlockSpec): the padded (B, Sk_pad) f32 key mask
-    with a per-batch full-row block (``b // heads`` maps the folded
-    batch*head grid index back to the batch), or a dummy lane-sized row
-    when masking is off (``has_mask`` statically skips the load)."""
+    """(mask array, its BlockSpec) for the per-key padding mask.
+
+    The mask is expanded host-side to ``(B*heads, 1, S_pad)`` so each
+    program's block is ``(1, 1, S_pad)`` indexed by the batch*head grid
+    id directly. The detours that do NOT work: a ``(1, S_pad)`` block on
+    a ``(B, S_pad)`` array violates Mosaic's block rule (sublane dim must
+    divide 8 or equal the array's — B is neither), a ``b // heads`` index
+    map lowers sign-correction selects Mosaic rejects, and an in-kernel
+    dynamic sublane pick breaks the interpreter's lowering. With the
+    leading axis folded to batch*heads and a unit sublane dim, the block
+    equals the array on its last two dims — legal everywhere, and the
+    replication costs B*heads*S_pad f32 (a few hundred KiB)."""
     if kv_mask is None:
-        dummy = jnp.ones((1, _LANE), jnp.float32)
+        dummy = jnp.ones((1, 1, _LANE), jnp.float32)
         return dummy, pl.BlockSpec(
-            (1, _LANE), lambda b, *_: (0, 0), memory_space=pltpu.VMEM
+            (1, 1, _LANE), lambda b, *_: (0, 0, 0), memory_space=pltpu.VMEM
         )
-    return kv_mask, pl.BlockSpec(
-        (1, sk_pad), lambda b, *_: (b // heads, 0), memory_space=pltpu.VMEM
+    kvm3 = jnp.repeat(kv_mask, heads, axis=0)[:, None, :]
+    return kvm3, pl.BlockSpec(
+        (1, 1, sk_pad), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
     )
+
+
+def _kvm_row(kvm_ref, start, size):
+    """(1, size) slice of this program's key-mask row."""
+    return kvm_ref[0, :, pl.ds(start, size)] > 0.0
 
 
 def _fwd(
@@ -172,7 +186,8 @@ def _fwd(
     aligned, qoff, koff = _offsets_smem(q_offset, k_offset)
     kvm, kvm_spec = _kvm_spec(kv_mask, s_pad, heads)
     kernel = functools.partial(
-        _fwd_kernel, causal, aligned, s_real, scale, _BK, kv_mask is not None
+        _fwd_kernel, causal, aligned, s_real, scale, _BK,
+        kv_mask is not None,
     )
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     return pl.pallas_call(
@@ -240,7 +255,7 @@ def _bwd_dq_kernel(
         if causal:
             mask = mask & (q_pos >= koff + k_local)
         if has_mask:
-            km = kvm_ref[:, pl.ds(j * bk, bk)] > 0.0  # (1, bk)
+            km = _kvm_row(kvm_ref, j * bk, bk)  # (1, bk)
             mask = mask & jnp.broadcast_to(km, (bq, bk))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
         dp = jax.lax.dot_general(
@@ -296,7 +311,7 @@ def _bwd_dkv_kernel(
         if causal:
             mask = mask & (q_pos >= k_pos)
         if has_mask:
-            km = kvm_ref[:, pl.ds(kj * bk, bk)] > 0.0  # (1, bk) — this block
+            km = _kvm_row(kvm_ref, kj * bk, bk)  # this kv block's keys
             mask = mask & jnp.broadcast_to(km, (bq, bk))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_new = dv + jax.lax.dot_general(

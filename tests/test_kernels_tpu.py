@@ -76,6 +76,28 @@ for rows, cols, k in [(1024, 512, 16), (37, 256, 5), (8, 128, 128)]:
     ok_topk &= bool(np.array_equal(np.asarray(i), np.asarray(li)))
     ok_topk &= bool(np.allclose(np.asarray(v), vref))
 out["topk_exact"] = ok_topk
+
+# chunk_scatter: the structured decompress/accumulate kernel, compiled
+from consensusml_tpu.compress.kernels import chunk_scatter
+rows, chunk, k = 513, 512, 8
+sv = jnp.asarray(rng.normal(size=(rows, k)), jnp.float32)
+si = jnp.asarray(
+    np.stack([rng.choice(chunk, size=k, replace=False) for _ in range(rows)]),
+    jnp.int32,
+)
+acc = jnp.asarray(rng.normal(size=(rows, chunk)), jnp.float32)
+got_sc = chunk_scatter(sv, si, chunk, acc, weight=0.25)
+want_sc = np.asarray(acc).copy()
+np.put_along_axis(
+    want_sc,
+    np.asarray(si),
+    np.take_along_axis(np.asarray(acc), np.asarray(si), axis=1)
+    + 0.25 * np.asarray(sv),
+    axis=1,
+)
+out["scatter_exact"] = bool(
+    np.allclose(np.asarray(got_sc), want_sc, atol=1e-6)
+)
 print(json.dumps(out))
 """
 
@@ -133,6 +155,17 @@ gf = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal=True, dtype=jnp.
 gd = jax.grad(lambda q: jnp.sum(dot_product_attention(q, k, v, causal=True, dtype=jnp.float32, impl="dense") ** 2))(q)
 scale = float(jnp.max(jnp.abs(gd)))
 out["dq_rel_err"] = float(jnp.max(jnp.abs(gf - gd))) / max(scale, 1e-9)
+
+# per-key padding mask (the BERT path) — compiled, vs dense additive bias
+kv_mask = jnp.asarray(np.stack([np.arange(s) < s, np.arange(s) < 700]), jnp.float32)
+bias = jnp.where(kv_mask[:, None, None, :] > 0, 0.0, -1e30)
+want_m = dot_product_attention(q, k, v, bias=bias, dtype=jnp.float32, impl="dense")
+got_m = flash_attention(q, k, v, kv_mask=kv_mask, dtype=jnp.float32)
+out["masked_fwd_max_err"] = float(jnp.max(jnp.abs(got_m - want_m)))
+gm = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, kv_mask=kv_mask, dtype=jnp.float32) ** 2))(q)
+gb = jax.grad(lambda q: jnp.sum(dot_product_attention(q, k, v, bias=bias, dtype=jnp.float32, impl="dense") ** 2))(q)
+mscale = float(jnp.max(jnp.abs(gb)))
+out["masked_dq_rel_err"] = float(jnp.max(jnp.abs(gm - gb))) / max(mscale, 1e-9)
 print(json.dumps(out))
 """
 
@@ -150,6 +183,8 @@ def test_flash_attention_on_tpu():
         pytest.skip(result["skip"])
     assert result["fwd_max_err"] < 0.02, result  # bf16-precision matmuls
     assert result["dq_rel_err"] < 0.02, result
+    assert result["masked_fwd_max_err"] < 0.02, result
+    assert result["masked_dq_rel_err"] < 0.02, result
 
 
 _FUSED_BN_CHILD = r"""

@@ -1,0 +1,154 @@
+"""GPT-2 batch/remat MFU sweep (docs/perf.md; VERDICT r2 item 3).
+
+The r2 claim "no step-time lever left at this workload shape" was only
+measured at batch 4 — but batch is itself the lever: optimizer cost and
+reductions amortize over more tokens. This sweeps batch x remat on the
+real chip and reports tokens/s and MFU so the claim either gains data or
+the headline rises. Each variant runs in a fresh subprocess (clean XLA
+client, honest compile; OOM in one variant cannot poison the next).
+
+MFU = model FLOPs / wall / peak. Model FLOPs per token = 6*N_base (N
+excluding the untied position table... we use 6*N_params, the standard
+PaLM convention) + 12*L*H*S (attention scores+values, causal halved),
+peak = 197 TFLOP/s bf16 (TPU v5e chip).
+
+Usage: python tools/lm_sweep.py [--batches 4,8,16] [--remat auto]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PEAK_BF16 = 197e12  # TPU v5e
+
+
+def run_variant(batch: int, remat: bool, steps: int) -> dict:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
+
+    cfg = GPT2Config(remat=remat)
+    model = GPT2LM(config=cfg)
+    s = 1024
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, s)), jnp.int32
+        )
+    }
+    loss_fn = gpt2_loss_fn(model)
+    tx = optax.adamw(2e-4)
+    params = model.init(jax.random.key(0), batch_data["input_ids"][:1])["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    carry0 = (params, tx.init(params), jax.random.key(1))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(carry):
+        def body(c, _):
+            params, opt_state, key = c
+            key, sub = jax.random.split(key)
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, {}, batch_data, sub
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state, key), loss
+
+        return jax.lax.scan(body, carry, None, length=steps)
+
+    carry, losses = multi(carry0)
+    float(losses[-1])  # compile + first run fence
+    t0 = time.time()
+    carry, losses = multi(carry)
+    final = float(losses[-1])
+    dt = time.time() - t0
+    tokens_sec = batch * s * steps / dt
+    # 6*N per token (fwd+bwd) + causal attention term, x3 for bwd recompute
+    attn = 12 * cfg.layers * cfg.hidden * s // 2
+    flops_tok = 6 * n_params + 3 * attn
+    mfu = tokens_sec * flops_tok / PEAK_BF16
+    dev = jax.local_devices()[0]
+    stats = dev.memory_stats() or {}
+    return {
+        "batch": batch,
+        "remat": remat,
+        "tokens_sec": round(tokens_sec, 1),
+        "step_ms": round(1000 * dt / steps, 2),
+        "mfu": round(mfu, 4),
+        "loss": round(final, 3),
+        "peak_hbm_gib": round(
+            stats.get("peak_bytes_in_use", 0) / 1024**3, 2
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="4,8,16")
+    ap.add_argument(
+        "--remat",
+        default="auto",
+        choices=("auto", "on", "off", "both"),
+        help="auto: off for small batches, on past 8 (the HBM bound)",
+    )
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    variants = []
+    for b in (int(x) for x in args.batches.split(",")):
+        if args.remat == "both":
+            variants += [(b, False), (b, True)]
+        elif args.remat == "auto":
+            variants.append((b, b > 8))
+        else:
+            variants.append((b, args.remat == "on"))
+
+    rows = []
+    for batch, remat in variants:
+        env = dict(os.environ)
+        env["LM_SWEEP_ONE"] = json.dumps(
+            {"batch": batch, "remat": remat, "steps": args.steps}
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_worker"],
+            capture_output=True, text=True, timeout=1800, env=env,
+            cwd=REPO,
+        )
+        got = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("ONE_RESULT "):
+                got = json.loads(line[len("ONE_RESULT "):])
+        if got is None:
+            got = {
+                "batch": batch,
+                "remat": remat,
+                "error": (proc.stderr or proc.stdout)[-400:],
+            }
+        rows.append(got)
+        print(f"# {json.dumps(got)}", file=sys.stderr, flush=True)
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    if "--_worker" in sys.argv:
+        spec = json.loads(os.environ["LM_SWEEP_ONE"])
+        print(
+            "ONE_RESULT "
+            + json.dumps(run_variant(spec["batch"], spec["remat"], spec["steps"])),
+            flush=True,
+        )
+    else:
+        main()
