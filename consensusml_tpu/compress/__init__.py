@@ -31,6 +31,7 @@ from consensusml_tpu.compress.kernels import (  # noqa: F401
     ChunkedTopKCompressor,
     PallasInt4Compressor,
     PallasInt8Compressor,
+    chunk_scatter,
 )
 from consensusml_tpu.compress.extra import (  # noqa: F401
     LowRankPayload,

@@ -318,7 +318,7 @@ def chunked_topk(chunks: jax.Array, k: int, *, interpret: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _scatter_kernel(k, weight, has_acc, vals_ref, idx_ref, *rest):
+def _scatter_kernel(k, has_acc, vals_ref, idx_ref, *rest):
     """Densify (R, k) chunk-local (value, index) pairs into (R, chunk).
 
     XLA's generic scatter-add costs ~69 ms for one full-model payload at
@@ -350,33 +350,36 @@ def _scatter_kernel(k, weight, has_acc, vals_ref, idx_ref, *rest):
         i = jnp.sum(jnp.where(sel, idx, 0), axis=1, keepdims=True)
         # top-k emits distinct in-chunk indices; padded-tail pairs carry
         # value 0, so their (clamped) position adds nothing
-        return out + jnp.where(colc == i, weight * v, 0.0)
+        return out + jnp.where(colc == i, v, 0.0)
 
     out = jax.lax.fori_loop(0, k, body, out)
     out_ref[:] = out.astype(out_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("chunk", "weight", "interpret")
-)
+@functools.partial(jax.jit, static_argnames=("chunk", "interpret"))
 def chunk_scatter(
     vals: jax.Array,
     idx: jax.Array,
     chunk: int,
     acc: jax.Array | None = None,
     *,
-    weight: float = 1.0,
+    weight=1.0,
     interpret: bool = False,
 ) -> jax.Array:
     """``(nchunks, k)`` values + chunk-local indices -> dense
-    ``(nchunks, chunk)`` f32, optionally ``acc + weight * dense``."""
+    ``(nchunks, chunk)`` f32, optionally ``acc + weight * dense``.
+
+    ``weight`` is applied by pre-scaling the (tiny) values array, not
+    inside the kernel: it stays traceable, costs one pass over
+    ``nchunks*k`` elements, and never forces a per-weight recompile.
+    """
     nchunks, k = vals.shape
     kpad = _round_up(k, _LANE)
     rows = _round_up(max(nchunks, _SUBLANE_F32), _SUBLANE_F32)
     block_rows = min(rows, 256)  # see chunked_topk: grid overhead at scale
     rows = _round_up(rows, block_rows)
     vals = jnp.pad(
-        jnp.asarray(vals, jnp.float32),
+        jnp.asarray(vals, jnp.float32) * weight,
         ((0, rows - nchunks), (0, kpad - k)),
     )
     idx = jnp.pad(
@@ -399,7 +402,7 @@ def chunk_scatter(
         )
         in_specs.append(cspec)
     out = pl.pallas_call(
-        functools.partial(_scatter_kernel, k, weight, acc is not None),
+        functools.partial(_scatter_kernel, k, acc is not None),
         grid=(rows // block_rows,),
         in_specs=in_specs,
         out_specs=cspec,
@@ -613,8 +616,6 @@ class ChunkedTopKCompressor(Compressor):
         impl = _resolve_impl(self.impl)
         if impl == "jnp" or not isinstance(payload, LocalTopKPayload):
             return None
-        if not isinstance(weight, (int, float)):
-            return None  # traced weight can't be a static kernel param
         n = 1
         for d in payload.shape:
             n *= d
@@ -629,7 +630,7 @@ class ChunkedTopKCompressor(Compressor):
                 flat = jnp.pad(flat, (0, rows * chunk - n))
             dense = chunk_scatter(
                 vals, payload.indices, chunk, flat.reshape(rows, chunk),
-                weight=float(weight), interpret=impl == "interpret",
+                weight=weight, interpret=impl == "interpret",
             )
         else:
             dense = chunk_scatter(

@@ -69,6 +69,18 @@ class GossipConfig:
     compressor: Compressor | None = None  # None => exact mixing
     gamma: float = 1.0  # CHOCO consensus step size (ignored when exact)
     path_filter: Any = None  # Callable[[tuple], bool] | None
+    # Which gossiped leaves ride the COMPRESSED (CHOCO) path; the rest
+    # mix exactly every round. "auto" (default) excludes the
+    # ``model_state`` subtree: sparse delta codecs are poison for
+    # BatchNorm RUNNING STATISTICS (top-k ships a few large innovations;
+    # the tracking error on never-selected slots compounds until the
+    # statistics — and with them every normalized activation — diverge;
+    # measured on the ResNet-50 convergence study: top-1 0.13 vs 0.80
+    # exact). Stats are ~0.2% of a ResNet's tree, so exact mixing for
+    # them costs nothing. None => compress everything (raw trees without
+    # a model_state key are unaffected by "auto"); or a callable
+    # ``path -> bool`` (True = compress that leaf).
+    compress_filter: Any = "auto"
     faults: FaultConfig | None = None  # None => no fault model
     push_sum: bool = False  # ratio consensus (see consensus.pushsum)
     # Fused codec: run the compressor ONCE over the CONCATENATED gossiped
@@ -189,6 +201,54 @@ class ConsensusEngine:
     def compressed(self) -> bool:
         return self.config.compressor is not None
 
+    # ---- compress-path filtering ----------------------------------------
+    def _compress_filter(self):
+        cf = self.config.compress_filter
+        if cf == "auto":
+            return lambda p: not (
+                p and getattr(p[0], "key", None) == "model_state"
+            )
+        return cf
+
+    def _partition(self, tree: Any):
+        """One flatten, BOTH filters on the ORIGINAL tree paths:
+        ``(compressed, exact_mixed, passthrough, rebuild)``.
+
+        ``path_filter`` decides what gossips at all (non-gossiped leaves
+        pass through untouched); ``compress_filter`` decides which
+        gossiped leaves ride CHOCO vs plain mixing. Both must see the
+        original paths — filtering in two stages would hand the second
+        filter a flat list whose SequenceKey paths match nothing, which
+        silently disabled the model_state exclusion. Returns
+        ``(tree, None, None, None)`` when every leaf is compressed, so
+        the common no-filter configs keep their exact state/payload tree
+        structure (and existing checkpoints their layout).
+        """
+        pf = self.config.path_filter
+        cf = self._compress_filter()
+        if pf is None and cf is None:
+            return tree, None, None, None
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        tags = []
+        for p, _ in flat:
+            if pf is not None and not pf(p):
+                tags.append("r")
+            elif cf is not None and not cf(p):
+                tags.append("e")
+            else:
+                tags.append("c")
+        if all(t == "c" for t in tags):
+            return tree, None, None, None
+        by = lambda t: [x for tg, (_, x) in zip(tags, flat) if tg == t]
+
+        def rebuild(c_new: list, e_new: list, r_new: list) -> Any:
+            its = {"c": iter(c_new), "e": iter(e_new), "r": iter(r_new)}
+            return jax.tree.unflatten(
+                treedef, [next(its[t]) for t in tags]
+            )
+
+        return by("c"), by("e"), by("r"), rebuild
+
     # ---- path filtering --------------------------------------------------
     def _select(self, tree: Any):
         """Split ``tree`` into the gossiped-leaf list + a rebuild closure.
@@ -232,8 +292,10 @@ class ConsensusEngine:
             )
         if not self.compressed:
             return None
-        if self.config.path_filter is not None:
-            params, _ = self._select(params)
+        # CHOCO state covers only the compressed leaves: exact-mixed
+        # leaves (BN stats under "auto") and non-gossiped leaves
+        # (path_filter) carry no tracking
+        params, _, _, _ = self._partition(params)
         if self.config.fused_codec:
             # CHOCO state lives FLAT: one (n,) vector per worker (or
             # (W, n) stacked), matching the fused round's compress domain
@@ -321,10 +383,13 @@ class ConsensusEngine:
             return mix_all(params), None
 
         comp = self.config.compressor
-        rebuild = None
-        if self.config.path_filter is not None:
-            # CHOCO runs on the filtered leaves; the rest pass through
-            params, rebuild = self._select(params)
+        # one partition over the original paths: CHOCO leaves / exact-mix
+        # leaves (BN stats) / passthrough (path_filter-excluded)
+        params, exact_leaves, rest_leaves, rebuild_split = self._partition(
+            params
+        )
+        if exact_leaves is not None:
+            mixed_exact = [collectives.mix(x, topo) for x in exact_leaves]
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
@@ -357,8 +422,10 @@ class ConsensusEngine:
         x_new = jax.tree.map(
             lambda new, old: new.astype(old.dtype), x_new, params
         )
-        if rebuild is not None:
-            x_new = rebuild(x_new)
+        if rebuild_split is not None:
+            x_new = rebuild_split(
+                jax.tree.leaves(x_new), mixed_exact, rest_leaves
+            )
         return x_new, ChocoState(xhat=xhat, s=s)
 
     # ---- overlap gossip (combine-then-adapt) ----------------------------
@@ -455,9 +522,12 @@ class ConsensusEngine:
             return simulated.mix_tree_stacked(params, w), None
 
         comp = self.config.compressor
-        rebuild = None
-        if self.config.path_filter is not None:
-            params, rebuild = self._select(params)
+        # same partition as the collective backend (original paths)
+        params, exact_leaves, rest_leaves, rebuild_split = self._partition(
+            params
+        )
+        if exact_leaves is not None:
+            mixed_exact = [simulated.mix_stacked(x, w) for x in exact_leaves]
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
@@ -490,8 +560,10 @@ class ConsensusEngine:
         if unravel is not None:
             x_new = unravel(x_new)
         x_new = jax.tree.map(lambda new, old: new.astype(old.dtype), x_new, params)
-        if rebuild is not None:
-            x_new = rebuild(x_new)
+        if rebuild_split is not None:
+            x_new = rebuild_split(
+                jax.tree.leaves(x_new), mixed_exact, rest_leaves
+            )
         return x_new, ChocoState(xhat=xhat, s=s)
 
     # ---- accounting -----------------------------------------------------
@@ -506,23 +578,35 @@ class ConsensusEngine:
         """
         import numpy as np
 
-        if self.config.path_filter is not None:
-            params, _ = self._select(params)
         comp = self.config.compressor
+        dense_bytes = lambda x: int(np.prod(x.shape)) * np.dtype(
+            jnp.float32
+        ).itemsize
+        exact_payload = 0
+        if comp is not None:
+            # exact-mixed leaves (compress_filter, e.g. BN stats) ship
+            # dense; path_filter-excluded leaves ship nothing
+            params, exact_leaves, _, _ = self._partition(params)
+            if exact_leaves is not None:
+                exact_payload = sum(dense_bytes(x) for x in exact_leaves)
+        elif self.config.path_filter is not None:
+            params, _ = self._select(params)
 
         def leaf_bytes(x) -> int:
-            shape = tuple(x.shape)
             if comp is None:
-                return int(np.prod(shape)) * np.dtype(jnp.float32).itemsize
-            return comp.wire_bytes(shape, jnp.float32)
+                return dense_bytes(x)
+            return comp.wire_bytes(tuple(x.shape), jnp.float32)
 
         if comp is not None and self.config.fused_codec:
             # one payload over the concatenated tree (the fused round's
             # actual wire), not a per-leaf sum
             n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
-            payload = comp.wire_bytes((n,), jnp.float32)
+            payload = comp.wire_bytes((n,), jnp.float32) + exact_payload
         else:
-            payload = sum(leaf_bytes(x) for x in jax.tree.leaves(params))
+            payload = (
+                sum(leaf_bytes(x) for x in jax.tree.leaves(params))
+                + exact_payload
+            )
         topo = self.topology
         if topo.is_time_varying:
             sends = sum(

@@ -244,3 +244,137 @@ def test_wire_bytes_per_round_accounting():
     # push-sum adds the mass scalar
     eng = ConsensusEngine(GossipConfig(topology=RingTopology(8), push_sum=True))
     assert eng.wire_bytes_per_round(params) == dense_bytes * 2 + 8
+
+
+def test_compress_filter_mixes_model_state_exactly():
+    """The "auto" compress filter: params ride CHOCO, the model_state
+    subtree (BN running statistics) mixes EXACTLY — sparse delta codecs
+    destroy running stats (measured: ResNet-50 study top-1 0.13 vs 0.80).
+    """
+    topo = RingTopology(8)
+    engine = ConsensusEngine(
+        GossipConfig(
+            topology=topo,
+            compressor=topk_int8_compressor(ratio=0.1, chunk=32),
+            gamma=0.5,
+        )
+    )
+    rng = np.random.default_rng(12)
+    tree = {
+        "params": {
+            "w": jnp.asarray(rng.normal(size=(8, 16, 8)), jnp.float32)
+        },
+        "model_state": {
+            "batch_stats": {
+                "var": jnp.asarray(
+                    1.0 + 0.1 * rng.random(size=(8, 32)), jnp.float32
+                )
+            }
+        },
+    }
+    w = simulated.mixing_matrix(topo)
+    state = engine.init_state(tree, world_size=8)
+    # CHOCO state exists for params only: one leaf, shaped like w
+    assert len(jax.tree.leaves(state.xhat)) == 1
+    out, _ = engine.round_simulated(tree, state, w)
+    # stats after ONE round equal exact mixing (no compression error)
+    want = simulated.mix_stacked(tree["model_state"]["batch_stats"]["var"], w)
+    np.testing.assert_allclose(
+        np.asarray(out["model_state"]["batch_stats"]["var"]),
+        np.asarray(want), rtol=1e-6, atol=1e-6,
+    )
+    # params went through the codec: NOT equal to exact mixing
+    wmix = simulated.mix_stacked(tree["params"]["w"], w)
+    assert float(jnp.max(jnp.abs(out["params"]["w"] - wmix))) > 1e-4
+    # and variances stayed positive (the failure mode this guards)
+    assert float(jnp.min(out["model_state"]["batch_stats"]["var"])) > 0
+
+
+def test_compress_filter_none_compresses_everything():
+    """compress_filter=None restores the old everything-compressed
+    behavior, and raw trees without model_state are untouched by auto."""
+    topo = RingTopology(4)
+    rng = np.random.default_rng(13)
+    tree = {
+        "params": {"w": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32)},
+        "model_state": {
+            "m": jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+        },
+    }
+    w = simulated.mixing_matrix(topo)
+    comp = topk_int8_compressor(ratio=0.5, chunk=32)
+    eng_none = ConsensusEngine(
+        GossipConfig(
+            topology=topo, compressor=comp, gamma=0.5, compress_filter=None
+        )
+    )
+    st = eng_none.init_state(tree, world_size=4)
+    # state spans BOTH subtrees when the filter is off
+    assert len(jax.tree.leaves(st.xhat)) == 2
+    out, _ = eng_none.round_simulated(tree, st, w)
+    mixed = simulated.mix_stacked(tree["model_state"]["m"], w)
+    assert float(jnp.max(jnp.abs(out["model_state"]["m"] - mixed))) > 1e-5
+
+
+def test_compress_filter_cross_backend_parity():
+    """Collective == simulated with the split active (BN-style tree)."""
+    topo = RingTopology(8)
+    engine = ConsensusEngine(
+        GossipConfig(
+            topology=topo,
+            compressor=TopKCompressor(ratio=0.25),
+            gamma=0.5,
+        )
+    )
+    rng = np.random.default_rng(14)
+    stacked = {
+        "params": {"w": jnp.asarray(rng.normal(size=(8, 8, 4)), jnp.float32)},
+        "model_state": {
+            "s": jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+        },
+    }
+    got_c = _run_collective(engine, stacked, rounds=3)
+    got_s = _run_simulated(engine, stacked, rounds=3)
+    for leaf_c, leaf_s in zip(jax.tree.leaves(got_c), jax.tree.leaves(got_s)):
+        np.testing.assert_allclose(leaf_c, leaf_s, rtol=1e-5, atol=1e-5)
+
+
+def test_compress_filter_composes_with_path_filter():
+    """path_filter (what gossips) and compress_filter (what compresses)
+    both act on ORIGINAL paths: a two-stage filter would silently lose
+    the model_state exclusion once paths became flat-list indices."""
+    topo = RingTopology(4)
+    engine = ConsensusEngine(
+        GossipConfig(
+            topology=topo,
+            compressor=topk_int8_compressor(ratio=0.25, chunk=32),
+            gamma=0.5,
+            # gossip everything except the frozen subtree
+            path_filter=lambda p: getattr(p[-1], "key", None) != "frozen",
+        )
+    )
+    rng = np.random.default_rng(15)
+    tree = {
+        "params": {
+            "w": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32),
+            "frozen": jnp.asarray(rng.normal(size=(4, 8)), jnp.float32),
+        },
+        "model_state": {
+            "var": jnp.asarray(1.0 + rng.random(size=(4, 32)), jnp.float32)
+        },
+    }
+    w = simulated.mixing_matrix(topo)
+    state = engine.init_state(tree, world_size=4)
+    # CHOCO tracks ONLY params/w: not frozen (path_filter), not var (auto)
+    assert len(jax.tree.leaves(state.xhat)) == 1
+    out, _ = engine.round_simulated(tree, state, w)
+    # frozen leaf passed through untouched
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["frozen"]), np.asarray(tree["params"]["frozen"])
+    )
+    # stats mixed EXACTLY despite the path_filter being present
+    np.testing.assert_allclose(
+        np.asarray(out["model_state"]["var"]),
+        np.asarray(simulated.mix_stacked(tree["model_state"]["var"], w)),
+        rtol=1e-6, atol=1e-6,
+    )

@@ -27,7 +27,15 @@ def _leaf_bytes(tree) -> int:
     )
 
 
-@pytest.mark.parametrize("name", ["mnist_mlp", "gpt2_topk", "cifar_resnet50"])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "mnist_mlp",
+        # the larger smoke states take ~20 s each to initialize: slow tier
+        pytest.param("gpt2_topk", marks=pytest.mark.slow),
+        pytest.param("cifar_resnet50", marks=pytest.mark.slow),
+    ],
+)
 def test_state_components_match_real_state(name):
     """predict()'s params/opt/gossip bytes equal the bytes of the state a
     run actually allocates (per worker)."""
@@ -65,6 +73,7 @@ def test_codec_terms_present_only_for_compressed_configs():
     assert gpt2["gossip"] == 2 * n_params
 
 
+@pytest.mark.slow  # builds all five FULL bundles (llama-7B eval_shape)
 def test_full_scale_predictions_fit_claimed_hardware():
     """The doc's pod-fit claims, as assertions: every full-scale config's
     per-device prediction fits a v4 chip's 32 GiB HBM; the single-chip

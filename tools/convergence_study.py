@@ -67,6 +67,37 @@ def build_workload(name: str, noise: float | None, batch: int | None):
             "holdout": 512,
             "eval_batch": 64,
         }
+    if name == "lm":
+        # config-5's own model family (decoder LM + Adam): the pairing
+        # BASELINE.json actually puts behind the top-k codec
+        import optax
+
+        from consensusml_tpu.data import SyntheticLM
+        from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
+        from consensusml_tpu.train import causal_lm_eval_fn
+
+        model = GPT2LM(
+            config=GPT2Config(
+                vocab_size=128, hidden=128, layers=4, heads=4, max_len=64,
+                dropout=0.0,
+            )
+        )
+        data = SyntheticLM(vocab_size=128, seq_len=32)
+        return {
+            "world": 8,
+            "h": 2,
+            "batch": batch or 16,
+            "loss_fn": gpt2_loss_fn(model),
+            "init": lambda r: model.init(r, jnp.zeros((1, 32), jnp.int32))[
+                "params"
+            ],
+            "eval_fn": causal_lm_eval_fn(model),
+            "data": data,
+            "opt": lambda: optax.adam(1e-3),
+            "scale": 1.0,
+            "holdout": None,  # LM eval batches come from the keyed stream
+            "eval_batch": 64,
+        }
     if name == "resnet":
         from consensusml_tpu.models import resnet50, resnet_init, resnet_loss_fn
 
@@ -179,43 +210,75 @@ def run_variant(cfg, wl, rounds: int) -> dict:
         make_simulated_train_step,
     )
 
+    from consensusml_tpu.data import lm_round_batches
+
     world, scale = wl["world"], wl["scale"]
+    is_lm = not hasattr(wl["data"], "images")  # SyntheticLM vs image data
     step = make_simulated_train_step(cfg, wl["loss_fn"])
     state = init_stacked_state(cfg, wl["init"], jax.random.key(0), world)
     # equal tokens-seen across the h-sweep: fewer rounds at larger H so
     # every row consumes the same number of microbatches
     n_rounds = max(1, (rounds * wl["h"]) // cfg.h)
+    batches = (
+        lm_round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds)
+        if is_lm
+        else round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds)
+    )
     losses, errs = [], []
-    for batch in round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds):
+    for i, batch in enumerate(batches):
         if scale != 1.0:
             batch = dict(batch, image=batch["image"] * scale)
         state, m = step(state, batch)
-        losses.append(float(m["loss"]))
-        errs.append(float(m["consensus_error"]))
+        # keep metrics ON DEVICE: a float() here is a host sync every
+        # round — ~1 s each over this box's tunneled backend, which made
+        # per-round fetches 20x the actual compute. Bound the dispatch
+        # queue with one sync every 25 rounds, fetch the rest at the end.
+        losses.append(m["loss"])
+        errs.append(m["consensus_error"])
+        if i % 25 == 24:
+            float(m["loss"])
+    losses = [float(v) for v in np.asarray(jnp.stack(losses))]
+    errs = [float(v) for v in np.asarray(jnp.stack(errs))]
 
-    held = wl["data"].holdout(wl["holdout"])
     eb = wl["eval_batch"]
+    if is_lm:
+        # held-out LM windows: same keyed sample stream, disjoint seeds
+        def eval_batches():
+            for r in range(8):
+                rng = np.random.default_rng((999_983, r))
+                yield {"input_ids": jnp.asarray(wl["data"].sample(rng, (eb,)))}
 
-    def eval_batches():
-        for r in range(wl["holdout"] // eb):
-            yield {
-                "image": jnp.asarray(held.images[r * eb : (r + 1) * eb]) * scale,
-                "label": jnp.asarray(held.labels[r * eb : (r + 1) * eb]),
-            }
+    else:
+        held = wl["data"].holdout(wl["holdout"])
+
+        def eval_batches():
+            for r in range(wl["holdout"] // eb):
+                yield {
+                    "image": jnp.asarray(held.images[r * eb : (r + 1) * eb])
+                    * scale,
+                    "label": jnp.asarray(held.labels[r * eb : (r + 1) * eb]),
+                }
 
     ev = evaluate(wl["eval_fn"], state, eval_batches())
+    # classifiers report held-out top-1; LMs report held-out nll
+    metric = "top1" if "top1" in ev["mean_model"] else "nll"
     return {
         "rounds": n_rounds,
+        "metric": metric,
         "final_loss": round(float(np.mean(losses[-5:])), 4),
         "consensus_error": round(errs[-1], 4),
-        "top1_consensus_model": round(float(ev["mean_model"]["top1"]), 4),
-        "top1_worker_mean": round(float(ev["worker_mean"]["top1"]), 4),
+        f"{metric}_consensus_model": round(
+            float(ev["mean_model"][metric]), 4
+        ),
+        f"{metric}_worker_mean": round(
+            float(ev["worker_mean"][metric]), 4
+        ),
     }
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--workload", choices=("mlp", "resnet"), default="mlp")
+    ap.add_argument("--workload", choices=("mlp", "resnet", "lm"), default="mlp")
     ap.add_argument("--rounds", type=int, default=80)
     ap.add_argument("--noise", type=float, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -226,7 +289,7 @@ def main() -> None:
         "--device",
         choices=("cpu", "tpu"),
         default=None,
-        help="default: cpu for mlp, accelerator (if present) for resnet",
+        help="default: cpu for mlp, accelerator (if present) otherwise",
     )
     ap.add_argument("--md", action="store_true", help="print a markdown table")
     ap.add_argument("--out", default=None, help="also write results JSON here")
@@ -255,16 +318,18 @@ def main() -> None:
             json.dump({"meta": meta, "rows": rows}, f, indent=2)
 
     if args.md:
+        metric = next(iter(rows.values()))["metric"]
+        label = "top-1" if metric == "top1" else "nll"
         print(
-            "| mode | rounds | final loss | consensus error |"
-            " top-1 (consensus model) | top-1 (worker mean) |"
+            f"| mode | rounds | final loss | consensus error |"
+            f" {label} (consensus model) | {label} (worker mean) |"
         )
         print("|---|---|---|---|---|---|")
         for name, r in rows.items():
             print(
                 f"| {name} | {r['rounds']} | {r['final_loss']} "
-                f"| {r['consensus_error']} | {r['top1_consensus_model']} "
-                f"| {r['top1_worker_mean']} |"
+                f"| {r['consensus_error']} | {r[f'{metric}_consensus_model']} "
+                f"| {r[f'{metric}_worker_mean']} |"
             )
     else:
         print(json.dumps(rows, indent=2))
