@@ -317,6 +317,9 @@ def main() -> None:
         with open(args.out, "w") as f:
             json.dump({"meta": meta, "rows": rows}, f, indent=2)
 
+    if args.md and not rows:
+        print("no variants matched --modes", file=sys.stderr)
+        return
     if args.md:
         metric = next(iter(rows.values()))["metric"]
         label = "top-1" if metric == "top1" else "nll"
