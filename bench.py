@@ -19,16 +19,27 @@ MLPerf-class figure for ResNet-50 training on one A100 — the reference's
 hardware. It is labeled in the "note" field; replace when the reference
 number becomes recoverable.
 
-A watchdog subprocess guards against a hung TPU tunnel (observed in this
-environment): if the inner run doesn't finish in BENCH_TIMEOUT seconds
-(default 2400), we report value 0 with a note rather than hanging the
-driver.
+Hang/budget resilience (VERDICT r3 item 1 — round 3's artifact was lost
+to a wedged tunnel + unbounded total):
+
+- a TPU-liveness PREFLIGHT (consensusml_tpu.utils.tpu_health) probes the
+  backend in a short-timeout subprocess before any axon-backed section is
+  committed to; if the tunnel is wedged, TPU sections are skipped (CPU
+  sections still run) and the headline line says so honestly;
+- a GLOBAL wall-clock budget (BENCH_TOTAL_BUDGET, default 2700 s — r02
+  completed well inside 3000 s) clips every section's subprocess timeout
+  to the time remaining, so the one JSON line the driver parses ALWAYS
+  lands before the driver's own deadline;
+- SIGTERM/SIGINT/SIGALRM handlers emit the headline JSON with whatever
+  sections completed — if the driver times us out anyway, its TERM is the
+  last chance to land a partial result instead of rc=124 with "".
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -640,92 +651,176 @@ def main() -> None:
         )
         return
 
+    start = time.time()
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "2700"))
+    deadline = start + budget
+    reserve = 45.0  # headroom for the final print inside the budget
     timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
 
-    def run_sub(flag: str, timeout_s: float, extra_env: dict | None = None):
+    # mutable headline state: whatever is here when emit() fires is the
+    # round's record — every path (success, budget, signal) goes through it
+    head = {
+        "value": 0.0,
+        "note": "no sections completed",
+    }
+    extras: dict = {}
+    emitted = [False]
+
+    def emit(suffix: str = "") -> None:
+        if emitted[0]:
+            return
+        emitted[0] = True
+        payload = {
+            "metric": "imgs/sec/chip (ResNet-50 consensus-SGD, bf16 224px)",
+            "value": round(head["value"], 2),
+            "unit": "imgs/sec/chip",
+            "vs_baseline": round(head["value"] / PROXY_BASELINE_IMGS_SEC_CHIP, 4),
+            "note": head["note"] + suffix,
+            "elapsed_s": round(time.time() - start, 1),
+            **extras,
+        }
+        sys.stdout.write("\n" + json.dumps(payload) + "\n")
+        sys.stdout.flush()
+
+    active_child: list = [None]
+
+    def on_signal(signum, frame):
+        # the driver's timeout delivers TERM before KILL — last chance to
+        # land a partial record instead of rc=124 with an empty tail
+        child = active_child[0]
+        if child is not None:
+            try:
+                child.kill()
+            except Exception:
+                pass
+        emit(f" [signal {signum} after {time.time() - start:.0f}s; partial results]")
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGALRM, on_signal)
+    signal.alarm(int(budget + reserve))  # backstop if clipping ever slips
+
+    def remaining() -> float:
+        return deadline - time.time() - reserve
+
+    class _Skip(Exception):
+        pass
+
+    def run_sub(flag: str, cap: float, extra_env: dict | None = None):
+        timeout_s = min(cap, remaining())
+        if timeout_s < 45:
+            raise _Skip(f"global budget exhausted ({budget:.0f}s)")
         env = dict(os.environ)
         if extra_env:
             env.update(extra_env)
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             env=env,
         )
-        for line in proc.stdout.splitlines():
+        active_child[0] = proc
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise
+        finally:
+            active_child[0] = None
+        for line in out.splitlines():
             if line.startswith("INNER_RESULT "):
                 return json.loads(line[len("INNER_RESULT "):])
         raise RuntimeError(
-            f"bench {flag} failed (rc={proc.returncode}): {proc.stderr[-800:]}"
+            f"bench {flag} failed (rc={proc.returncode}): {err[-800:]}"
         )
 
-    extras: dict = {}
-    try:
-        result = run_sub("--_inner", timeout)
-        value = result["imgs_sec"]
-        batch = int(os.environ.get("BENCH_BATCH", "128"))
-        image = int(os.environ.get("BENCH_IMAGE", "224"))
-        note = (
-            f"ResNet-50 local-SGD round on {result['device']} "
-            f"({result['platform']}), batch {batch} @ {image}px, "
-            f"step {result['step_ms']:.1f}ms, "
-            f"compile {result['compile_s']:.0f}s; vs_baseline uses PROXY "
-            f"2500 imgs/s/chip (no published reference number, see BASELINE.md)"
-        )
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        value = 0.0
-        note = f"bench failed: {type(e).__name__}: {str(e)[:300]}"
+    # ---- preflight: is the TPU tunnel alive? (wedged twice on this box;
+    # committing axon-backend subprocesses to a dead tunnel burns every
+    # section's full timeout and the driver sees nothing)
+    from consensusml_tpu.utils.tpu_health import probe
 
-    # the consensus-error half of the headline metric (8-worker ring on a
-    # virtual CPU mesh — gossip collectives need >1 device) and the codec
-    # kernel micro-bench; failures are reported but never mask imgs/sec
+    forced_device = os.environ.get("BENCH_DEVICE")
+    tpu_ok = True
+    if forced_device:
+        extras["preflight"] = {"skipped": f"BENCH_DEVICE={forced_device} forced"}
+    else:
+        health = probe(
+            timeout=max(30.0, min(float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "150")),
+                                  remaining()))
+        )
+        extras["preflight"] = {
+            k: health.get(k)
+            for k in ("alive", "tpu", "platform", "device_kind", "elapsed_s", "reason")
+            if health.get(k) not in (None, "")
+        }
+        tpu_ok = bool(health["tpu"])
+
+    cpu_env = {"BENCH_DEVICE": "cpu"}
+    sections: list[tuple[str, str, float, dict | None]] = []
+    if tpu_ok:
+        head["note"] = "inner section did not complete"
+        sections.append(("_headline", "--_inner", timeout, None))
+    else:
+        pf = extras["preflight"]
+        why = (
+            f"backend alive but platform is {pf.get('platform')!r} (no TPU)"
+            if pf.get("alive")
+            else f"tunnel not alive ({pf.get('reason', 'unknown')})"
+        )
+        head["note"] = f"TPU sections skipped: {why}; CPU sections below still ran"
+
     flags = os.environ.get("XLA_FLAGS", "")
     flags = " ".join(
         f for f in flags.split() if "host_platform_device_count" not in f
     )
-    try:
-        extras["consensus"] = run_sub(
-            "--_consensus",
-            1500,  # ResNet-18 fwd+bwd x8 workers on the CPU mesh: compile-heavy
-            {"XLA_FLAGS": (flags + " --xla_force_host_platform_device_count=8").strip()},
-        )
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["consensus"] = {"error": str(e)[:300]}
-    try:
-        extras["codec"] = run_sub("--_codec", 900)
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["codec"] = {"error": str(e)[:300]}
-    try:
-        extras["attention"] = run_sub("--_attention", 900)
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["attention"] = {"error": str(e)[:300]}
-    try:
-        extras["gpt2"] = run_sub("--_gpt2", 900)
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["gpt2"] = {"error": str(e)[:300]}
-    try:
-        extras["gossip_round"] = run_sub("--_gossip_round", 1500)
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["gossip_round"] = {"error": str(e)[:300]}
-    try:
-        extras["fed_input"] = run_sub("--_fed", 1500)
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        extras["fed_input"] = {"error": str(e)[:300]}
+    # the consensus-error half of the headline metric always runs on the
+    # virtual CPU mesh (gossip collectives need >1 device) — wedged tunnel
+    # or not
+    sections.append((
+        "consensus", "--_consensus", 1500,
+        {"XLA_FLAGS": (flags + " --xla_force_host_platform_device_count=8").strip()},
+    ))
+    micro_env = None if tpu_ok else cpu_env
+    sections.append(("codec", "--_codec", 900, micro_env))
+    sections.append(("attention", "--_attention", 900, micro_env))
+    sections.append(("gpt2", "--_gpt2", 900, micro_env))
+    sections.append(("gossip_round", "--_gossip_round", 1500, micro_env))
+    if tpu_ok:  # host->device transfer bench is meaningless without the tunnel
+        sections.append(("fed_input", "--_fed", 1500, None))
 
-    print(
-        json.dumps(
-            {
-                "metric": "imgs/sec/chip (ResNet-50 consensus-SGD, bf16 224px)",
-                "value": round(value, 2),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": round(value / PROXY_BASELINE_IMGS_SEC_CHIP, 4),
-                "note": note,
-                **extras,
-            }
-        )
-    )
+    try:
+        for name, flag, cap, extra_env in sections:
+            try:
+                result = run_sub(flag, cap, extra_env)
+            except _Skip as e:
+                extras[name] = {"skipped": str(e)}
+                continue
+            except (subprocess.TimeoutExpired, RuntimeError) as e:
+                msg = f"{type(e).__name__}: {str(e)[:300]}"
+                if name == "_headline":
+                    head["note"] = f"inner bench failed: {msg}"
+                else:
+                    extras[name] = {"error": msg}
+                continue
+            if name == "_headline":
+                head["value"] = result["imgs_sec"]
+                batch = int(os.environ.get("BENCH_BATCH", "128"))
+                image = int(os.environ.get("BENCH_IMAGE", "224"))
+                head["note"] = (
+                    f"ResNet-50 local-SGD round on {result['device']} "
+                    f"({result['platform']}), batch {batch} @ {image}px, "
+                    f"step {result['step_ms']:.1f}ms, "
+                    f"compile {result['compile_s']:.0f}s; vs_baseline uses PROXY "
+                    f"2500 imgs/s/chip (no published reference number, see BASELINE.md)"
+                )
+            else:
+                extras[name] = result
+    finally:
+        emit()
 
 
 if __name__ == "__main__":

@@ -66,6 +66,24 @@ def _round_up(a: int, b: int) -> int:
     return _cdiv(a, b) * b
 
 
+# VMEM discipline: cap each block's widest f32 buffer at ~2 MiB
+# (_BLOCK_ELEM_BUDGET f32 elements). The compressors permit chunk widths
+# up to 65536 (the narrow-indices bound), where a fixed 256-row block
+# would be a 64 MiB buffer that can never fit VMEM; deriving rows from
+# the budget keeps wide chunks legal while leaving the measured 256-row
+# blocking untouched at the shipped chunk sizes (256 rows only shrinks
+# once chunk exceeds 2048). Floored at the sublane multiple — a hard
+# layout constraint, so extreme widths may still exceed the budget by
+# design rather than fail to tile.
+_BLOCK_ELEM_BUDGET = 512 * 1024
+
+
+def _block_rows(rows: int, width: int, sublane: int) -> int:
+    cap = _BLOCK_ELEM_BUDGET // max(width, 1)
+    cap = max((cap // sublane) * sublane, sublane)
+    return min(rows, 256, cap)
+
+
 # ---------------------------------------------------------------------------
 # int8 quantize / dequantize
 # ---------------------------------------------------------------------------
@@ -90,7 +108,7 @@ def quantize_int8(chunks: jax.Array, *, interpret: bool = False):
     """
     nchunks, chunk = chunks.shape
     rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
-    block_rows = min(rows, 256)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
     rows = _round_up(rows, block_rows)
     if rows != nchunks:
         chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
@@ -122,7 +140,7 @@ def dequantize_int8(q: jax.Array, scales: jax.Array, *, interpret: bool = False)
     """Inverse of :func:`quantize_int8`."""
     nchunks, chunk = q.shape
     rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
-    block_rows = min(rows, 256)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
     rows = _round_up(rows, block_rows)
     if rows != nchunks:
         q = jnp.pad(q, ((0, rows - nchunks), (0, 0)))
@@ -172,7 +190,7 @@ def quantize_int4(chunks: jax.Array, *, interpret: bool = False):
     nchunks, chunk = chunks.shape
     half = chunk // 2
     rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
-    block_rows = min(rows, 256)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
     rows = _round_up(rows, block_rows)
     if rows != nchunks:
         chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
@@ -208,7 +226,7 @@ def dequantize_int4(packed: jax.Array, scales: jax.Array, *, interpret: bool = F
     (nchunks, 2*half) f32``."""
     nchunks, half = packed.shape
     rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
-    block_rows = min(rows, 256)
+    block_rows = _block_rows(rows, 2 * half, _SUBLANE_I8)
     rows = _round_up(rows, block_rows)
     if rows != nchunks:
         packed = jnp.pad(packed, ((0, rows - nchunks), (0, 0)))
@@ -289,7 +307,8 @@ def chunked_topk(chunks: jax.Array, k: int, *, interpret: bool = False):
     # big row blocks: at full-model scale (~700k chunks) the grid-step
     # overhead dominates a small-block kernel; 256 rows x 512 lanes f32
     # is 512 KiB/buffer, comfortably inside VMEM with double buffering
-    block_rows = min(rows, 256)
+    # (wider chunks shrink the block to honor the VMEM budget)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_F32)
     rows = _round_up(rows, block_rows)
     if rows != nchunks:
         chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
@@ -376,7 +395,7 @@ def chunk_scatter(
     nchunks, k = vals.shape
     kpad = _round_up(k, _LANE)
     rows = _round_up(max(nchunks, _SUBLANE_F32), _SUBLANE_F32)
-    block_rows = min(rows, 256)  # see chunked_topk: grid overhead at scale
+    block_rows = _block_rows(rows, chunk, _SUBLANE_F32)  # see chunked_topk
     rows = _round_up(rows, block_rows)
     vals = jnp.pad(
         jnp.asarray(vals, jnp.float32) * weight,

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import warnings
 from typing import Any
 
 import jax
@@ -145,8 +146,45 @@ def checkpoint_round(path: str) -> int | None:
 
 
 def restore_state(path: str, like: Any) -> Any:
-    """Restore a checkpoint into the structure/shardings of ``like``."""
+    """Restore a checkpoint into the structure/shardings of ``like``.
+
+    Gossip-state layout drift is healed rather than fatal: when the
+    ONLY structural mismatch is under the ``gossip`` subtree (e.g. a
+    pre-``compress_filter="auto"`` checkpoint whose ChocoState covered
+    ``model_state`` leaves that the current engine exact-mixes), the
+    rest of the state is restored and the gossip state is RESET to
+    ``like``'s freshly-initialized zeros — the same recovery
+    ``utils.elastic.resize_state`` applies on a world-size change.
+    CHOCO re-warms its error-feedback over the next few rounds; params,
+    optimizer state, rng and step restore exactly.
+    """
     path = os.path.abspath(path)
+    try:
+        return _restore(path, like)
+    except ValueError as e:
+        if "gossip" not in str(e) or not hasattr(like, "gossip"):
+            raise
+        disk_gossip = _disk_gossip_template(path)
+        if disk_gossip is None:
+            raise
+        # PLACEHOLDER leaves satisfy the structural match WITHOUT reading
+        # the obsolete gossip bytes (xhat+s ~ 2x model size) off disk —
+        # they restore as `...` and are replaced below
+        hybrid = like._replace(
+            gossip=jax.tree.map(lambda _: ocp.PLACEHOLDER, disk_gossip)
+        )
+        restored = _restore(path, hybrid)  # re-raises if more than gossip drifted
+        warnings.warn(
+            "checkpoint gossip state has an old layout (it predates a "
+            "compress_filter/codec change); gossip tracking state was "
+            "RESET — compressed gossip re-warms its error feedback over "
+            "the next few rounds, everything else restored exactly",
+            stacklevel=2,
+        )
+        return restored._replace(gossip=like.gossip)
+
+
+def _restore(path: str, like: Any) -> Any:
     with ocp.PyTreeCheckpointer() as ckptr:
         restore_args = jax.tree.map(
             lambda x: ocp.ArrayRestoreArgs(sharding=getattr(x, "sharding", None)),
@@ -155,3 +193,20 @@ def restore_state(path: str, like: Any) -> Any:
         return ckptr.restore(
             path, args=ocp.args.PyTreeRestore(item=like, restore_args=restore_args)
         )
+
+
+def _disk_gossip_template(path: str) -> Any | None:
+    """The ON-DISK structure of the checkpoint's ``gossip`` subtree as
+    abstract arrays (for a structure-matching throwaway restore), or
+    None when the checkpoint has no such subtree."""
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            md = ckptr.metadata(path)
+        tree = getattr(getattr(md, "item_metadata", md), "tree", None)
+        if not isinstance(tree, dict) or "gossip" not in tree:
+            return None
+        return jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), tree["gossip"]
+        )
+    except Exception:
+        return None

@@ -1,0 +1,127 @@
+"""TPU-backend liveness preflight.
+
+This box's tunneled TPU backend has been observed (rounds 1 and 3) to
+WEDGE: a process's first ``jax.devices()`` call blocks forever instead of
+raising, so any entry point that probes the backend in-process hangs past
+the driver's budget and its artifact is lost. The fix is to never let the
+*caller's* process initialize the backend speculatively: :func:`probe`
+runs ``jax.devices()`` in a disposable child process under a hard timeout
+and reports alive/wedged plus the device kind.
+
+Used by ``bench.py`` (preflight before committing to axon-backend
+sections), ``train.py --device tpu`` (fail fast with a clean error
+instead of an infinite hang), and runnable standalone via
+``python tools/tpu_health.py``.
+
+Reference parity: no reference equivalent exists (NCCL has its own
+timeout machinery); this is resilience against this environment's
+tunnel, per VERDICT r3 items 1/6/7. SURVEY.md mount is empty — no
+file:line can be cited.
+
+Test hooks: ``TPU_HEALTH_CMD`` replaces the child's ``-c`` program (e.g.
+an infinite sleep to fake a wedged tunnel) and ``TPU_HEALTH_TIMEOUT``
+overrides the default timeout, so the hang path is testable in CI
+without a TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_MARK = "TPU_HEALTH "
+
+# The child resolves the platform exactly the way any other subprocess on
+# this box would (sitecustomize overrides JAX_PLATFORMS, so we don't try
+# to force anything) and prints one marked JSON line.
+_CHILD = (
+    "import json, jax; ds = jax.devices(); "
+    "print('TPU_HEALTH ' + json.dumps({"
+    "'platform': jax.default_backend(), "
+    "'n_devices': len(ds), "
+    "'device_kind': getattr(ds[0], 'device_kind', '') if ds else ''}))"
+)
+
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def probe(timeout: float | None = None) -> dict:
+    """Probe backend liveness in a subprocess; never hangs the caller.
+
+    Returns a dict with keys:
+
+    - ``alive`` — the child initialized a jax backend and exited in time.
+    - ``tpu`` — ``alive`` and the default platform is a TPU one.
+    - ``platform`` / ``n_devices`` / ``device_kind`` — from the child.
+    - ``elapsed_s`` — wall clock spent probing.
+    - ``reason`` — human diagnostic when not ``alive``.
+    """
+    if timeout is None:
+        timeout = float(os.environ.get("TPU_HEALTH_TIMEOUT", "120"))
+    child = os.environ.get("TPU_HEALTH_CMD", _CHILD)
+    out: dict = {
+        "alive": False,
+        "tpu": False,
+        "platform": None,
+        "n_devices": 0,
+        "device_kind": "",
+        "timeout_s": timeout,
+    }
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        out["elapsed_s"] = round(time.time() - t0, 1)
+        out["reason"] = (
+            f"backend probe did not return within {timeout:.0f}s — "
+            "jax.devices() is hanging (wedged TPU tunnel?)"
+        )
+        return out
+    out["elapsed_s"] = round(time.time() - t0, 1)
+    if proc.returncode != 0:
+        out["reason"] = (
+            f"backend probe exited rc={proc.returncode}: "
+            f"{proc.stderr.strip()[-400:]}"
+        )
+        return out
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MARK):
+            try:
+                info = json.loads(line[len(_MARK):])
+            except json.JSONDecodeError:
+                out["reason"] = f"unparseable probe output: {line[:200]}"
+                return out
+            out.update(info)
+            out["alive"] = True
+            out["tpu"] = out.get("platform") in TPU_PLATFORMS
+            return out
+    out["reason"] = f"probe printed no {_MARK.strip()} line: {proc.stdout[-200:]}"
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: print the probe JSON; rc 0 = TPU alive, 1 = alive but no
+    TPU (CPU-only), 2 = wedged or errored."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=None,
+                   help="probe timeout in seconds (default $TPU_HEALTH_TIMEOUT or 120)")
+    args = p.parse_args(argv)
+    result = probe(timeout=args.timeout)
+    print(json.dumps(result))
+    if not result["alive"]:
+        return 2
+    return 0 if result["tpu"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

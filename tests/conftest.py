@@ -104,6 +104,13 @@ _SLOW_TESTS = {
     # two-controller jax.distributed run (subprocess pair + compiles)
     "test_two_process_collective_training",
     "test_two_process_checkpoint_and_eval",
+    # round-4 hang-guard subprocess tests (fresh interpreters / heavy imports)
+    "test_cli_exit_codes",
+    "test_train_device_tpu_wedged_gives_clean_error",
+    "test_train_device_tpu_cpu_only_gives_clean_error",
+    "test_bench_emits_headline_json_when_budget_exhausted",
+    "test_bench_wedged_preflight_skips_tpu_sections",
+    "test_bench_sigterm_lands_partial_json",
 }
 
 

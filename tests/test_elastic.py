@@ -168,3 +168,58 @@ def test_resize_resets_pushsum_mass():
     resized = resize_state(ps_cfg, state, 6, rng=jax.random.key(5))
     assert resized.gossip is not None
     np.testing.assert_array_equal(np.asarray(resized.gossip.w), np.ones(6))
+
+
+def test_restore_resets_old_gossip_layout(tmp_path):
+    """A checkpoint whose ChocoState has an OLD leaf layout (e.g.
+    pre-compress_filter="auto" runs tracked model_state leaves) must
+    restore with gossip state RESET instead of failing structurally
+    (ADVICE r3); everything else restores exactly."""
+    import warnings
+
+    from consensusml_tpu.consensus.engine import ChocoState
+    from consensusml_tpu.utils import restore_state, save_state
+
+    codec = topk_int8_compressor(chunk=128, k=8)
+    _, _, state, _ = _trained_state(world=4, rounds=2, compressor=codec)
+    old_gossip = ChocoState(
+        xhat={"params": state.gossip.xhat, "model_state": {"bn": jnp.ones((4, 3))}},
+        s={"params": state.gossip.s, "model_state": {"bn": jnp.ones((4, 3))}},
+    )
+    path = save_state(str(tmp_path / "old_layout"), state._replace(gossip=old_gossip))
+
+    _, _, template, _ = _trained_state(world=4, rounds=0, compressor=codec)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        restored = restore_state(path, template)
+    assert any("gossip" in str(w.message) and "RESET" in str(w.message) for w in caught)
+    # gossip reset to the template's fresh zeros
+    assert all((np.asarray(l) == 0).all() for l in jax.tree.leaves(restored.gossip))
+    # params/step restored from the checkpoint, not the template
+    np.testing.assert_array_equal(np.asarray(restored.step), np.asarray(state.step))
+    for a, b in zip(jax.tree.leaves(restored.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_still_fails_on_non_gossip_mismatch(tmp_path):
+    """The gossip-reset fallback must not mask real template mismatches
+    (e.g. optimizer state from different LR flags)."""
+    import dataclasses
+
+    from consensusml_tpu.utils import restore_state, save_state
+
+    _, _, state, _ = _trained_state(world=4, rounds=1)
+    path = save_state(str(tmp_path / "ok_layout"), state)
+
+    bad_cfg = dataclasses.replace(
+        _cfg(4), optimizer=optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-2))
+    )
+    model = MLP(hidden=16)
+    bad_template = init_stacked_state(
+        bad_cfg,
+        lambda r: model.init(r, jnp.zeros((1, 28, 28, 1)))["params"],
+        jax.random.key(0),
+        4,
+    )
+    with pytest.raises(Exception):
+        restore_state(path, bad_template)

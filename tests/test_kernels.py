@@ -192,3 +192,62 @@ def test_kernel_scatter_payload_parity_with_fallback():
     got = interp.decompress_accumulate(p, acc, 0.25)
     want = ref.decompress_accumulate(ref.compress(x), acc, 0.25)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_block_rows_vmem_budget():
+    """Wide chunks must shrink the row block so no VMEM buffer exceeds
+    the budget (ADVICE r3: a hard 256-row block at chunk=65536 is a
+    64 MiB buffer that can never fit)."""
+    from consensusml_tpu.compress.kernels import (
+        _BLOCK_ELEM_BUDGET,
+        _SUBLANE_F32,
+        _SUBLANE_I8,
+        _block_rows,
+    )
+
+    # shipped sizes keep the measured 256-row blocking
+    assert _block_rows(100000, 512, _SUBLANE_F32) == 256
+    assert _block_rows(100000, 2048, _SUBLANE_F32) == 256
+    # wide chunks honor the budget
+    for chunk in (4096, 16384, 65536):
+        br = _block_rows(100000, chunk, _SUBLANE_F32)
+        assert br * chunk <= _BLOCK_ELEM_BUDGET
+        assert br % _SUBLANE_F32 == 0 and br >= _SUBLANE_F32
+    # the sublane multiple is a hard floor even past the budget
+    assert _block_rows(100000, 65536, _SUBLANE_I8) == _SUBLANE_I8
+    # small inputs never exceed their row count
+    assert _block_rows(8, 512, _SUBLANE_F32) == 8
+
+
+def test_wide_chunk_kernels_roundtrip():
+    """Kernels stay correct when the budget shrinks the block (multi-
+    block grid over a 16384-wide chunk)."""
+    rng = np.random.default_rng(7)
+    chunks = jnp.asarray(rng.normal(size=(100, 16384)), jnp.float32)
+
+    q, s = quantize_int8(chunks, interpret=True)
+    ref = Int8Compressor(chunk=16384).compress(chunks.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(q).reshape(-1), np.asarray(ref.data))
+    # 1-ulp scale slack: the blocked max reduces the 16384-wide row in a
+    # different association order than the jnp reference
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ref.scales), rtol=1e-6)
+    out = dequantize_int8(q, s, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(q, np.float32) * np.asarray(s)[:, None],
+        rtol=1e-6,
+    )
+
+    k = 4
+    vals, idx = chunked_topk(chunks, k, interpret=True)
+    _, ref_idx = jax.lax.top_k(jnp.abs(chunks), k)
+    ref_vals = jnp.take_along_axis(chunks, ref_idx, axis=1)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_vals))
+
+    from consensusml_tpu.compress.kernels import chunk_scatter
+
+    dense = chunk_scatter(vals, idx, 16384, interpret=True)
+    ref_dense = np.zeros((100, 16384), np.float32)
+    np.put_along_axis(ref_dense, np.asarray(idx), np.asarray(vals), axis=1)
+    np.testing.assert_allclose(np.asarray(dense), ref_dense, rtol=1e-6)

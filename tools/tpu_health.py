@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""CLI wrapper for the TPU-backend liveness preflight.
+
+Prints one JSON line (see consensusml_tpu.utils.tpu_health.probe).
+Exit codes: 0 = TPU alive, 1 = backend alive but CPU-only, 2 = wedged.
+
+Run this before any chip work on this box; a wedged tunnel makes every
+in-process ``jax.devices()`` call hang forever (observed rounds 1, 3).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensusml_tpu.utils.tpu_health import main
+
+if __name__ == "__main__":
+    sys.exit(main())

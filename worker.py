@@ -85,8 +85,18 @@ def _prevalidate_rendezvous(
                     continue
                 conn.settimeout(5.0)
                 try:
-                    raw = conn.recv(256)
-                    msg = json.loads(raw.decode()) if raw else None
+                    # newline-framed: a single recv can return a FRAGMENT
+                    # of the peer's JSON (then parsed as invalid and the
+                    # peer misdiagnosed as a stray connection) — read
+                    # until the delimiter, EOF, or a size cap
+                    buf = b""
+                    while b"\n" not in buf and len(buf) < 4096:
+                        part = conn.recv(256)
+                        if not part:
+                            break
+                        buf += part
+                    line = buf.split(b"\n", 1)[0]
+                    msg = json.loads(line.decode()) if line else None
                 except (OSError, ValueError):
                     msg = None
                 peer_n, peer_id = (
@@ -146,12 +156,29 @@ def _prevalidate_rendezvous(
     try:
         conn.settimeout(max(1.0, deadline - time.monotonic()))
         conn.sendall(
-            json.dumps(
-                {"num_processes": num_processes, "process_id": process_id}
+            (
+                json.dumps(
+                    {"num_processes": num_processes, "process_id": process_id}
+                )
+                + "\n"
             ).encode()
         )
+        # half-close the write side: the coordinator's framed read sees a
+        # deterministic EOF even if the newline fragment is delayed
         try:
-            resp = json.loads(conn.recv(512).decode() or "{}")
+            conn.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        try:
+            # the coordinator sends its verdict then closes — read to EOF
+            # so a fragmented reply still parses
+            buf = b""
+            while len(buf) < 4096:
+                part = conn.recv(512)
+                if not part:
+                    break
+                buf += part
+            resp = json.loads(buf.decode() or "{}")
         except socket.timeout:
             # the coordinator replies only once ALL peers check in — a
             # timeout here means somebody else never arrived, not that
