@@ -693,6 +693,14 @@ def main() -> None:
                 child.kill()
             except Exception:
                 pass
+        try:
+            # a preflight probe hung on a wedged tunnel must not be
+            # orphaned holding the backend
+            from consensusml_tpu.utils.tpu_health import kill_active_probe
+
+            kill_active_probe()
+        except Exception:
+            pass
         emit(f" [signal {signum} after {time.time() - start:.0f}s; partial results]")
         os._exit(0)
 
@@ -797,7 +805,10 @@ def main() -> None:
             try:
                 result = run_sub(flag, cap, extra_env)
             except _Skip as e:
-                extras[name] = {"skipped": str(e)}
+                if name == "_headline":
+                    head["note"] = f"inner section skipped: {e}"
+                else:
+                    extras[name] = {"skipped": str(e)}
                 continue
             except (subprocess.TimeoutExpired, RuntimeError) as e:
                 msg = f"{type(e).__name__}: {str(e)[:300]}"

@@ -113,12 +113,19 @@ def mix_tree_masked(tree: Any, topology: Topology, alive: jax.Array) -> Any:
     )
 
 
-def consensus_error(tree: Any, topology: Topology) -> jax.Array:
+def consensus_error(
+    tree: Any, topology: Topology, shard_axes: tuple[str, ...] = ()
+) -> jax.Array:
     """RMS disagreement across workers: ``sqrt(mean_i ||theta_i - theta_bar||^2)``.
 
     Half of the reference's headline metric (BASELINE.json ``metric``:
     "imgs/sec/chip + consensus-error"). Computed entirely on-device with
     two ``pmean``s — no gather of full parameter sets to the host.
+
+    ``shard_axes``: manual MODEL axes the tree is sharded over inside the
+    current ``shard_map`` (e.g. ``("pp",)`` when each device holds its
+    pipeline stage's layer slice) — the squared deviation is psum'd over
+    them so the metric covers the whole model and stays replicated.
     """
     axes = topology.axis_names
     mean = jax.tree.map(lambda x: jax.lax.pmean(jnp.asarray(x, jnp.float32), axes), tree)
@@ -126,4 +133,6 @@ def consensus_error(tree: Any, topology: Topology) -> jax.Array:
         jnp.sum((jnp.asarray(x, jnp.float32) - m) ** 2)
         for x, m in zip(jax.tree.leaves(tree), jax.tree.leaves(mean))
     )
+    if shard_axes:
+        sq = jax.lax.psum(sq, shard_axes)
     return jnp.sqrt(jax.lax.pmean(sq, axes))

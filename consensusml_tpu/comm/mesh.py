@@ -85,6 +85,7 @@ class WorkerMesh:
     topology: Topology
     mesh: Mesh
     model_axes: tuple[tuple[str, int], ...] = ()
+    manual_model_axes: tuple[str, ...] = ()
 
     @classmethod
     def create(
@@ -93,10 +94,22 @@ class WorkerMesh:
         devices: Sequence[jax.Device] | None = None,
         platform: str | None = None,
         model_axes: Sequence[tuple[str, int]] = (),
+        manual_model_axes: Sequence[str] = (),
     ) -> "WorkerMesh":
+        """``manual_model_axes`` marks model axes whose collectives the
+        per-worker computation writes ITSELF (``shard_map`` manual mode)
+        rather than leaving to XLA's auto sharding — pipeline parallelism
+        needs this: ``pipeline_apply``'s stage-to-stage ``ppermute`` is a
+        hand-written collective over the ``pp`` axis, unlike TP whose
+        psums XLA derives from sharding annotations."""
         model_axes = tuple((str(n), int(s)) for n, s in model_axes)
+        manual_model_axes = tuple(str(n) for n in manual_model_axes)
         if overlap := {n for n, _ in model_axes} & set(topology.axis_names):
             raise ValueError(f"model axes {sorted(overlap)} collide with worker axes")
+        if missing := set(manual_model_axes) - {n for n, _ in model_axes}:
+            raise ValueError(
+                f"manual_model_axes {sorted(missing)} are not model axes"
+            )
         per_worker = int(np.prod([s for _, s in model_axes])) if model_axes else 1
         need = topology.world_size * per_worker
         if devices is None:
@@ -109,16 +122,24 @@ class WorkerMesh:
         shape = (*topology.mesh_shape, *(s for _, s in model_axes))
         names = (*topology.axis_names, *(n for n, _ in model_axes))
         dev_array = np.asarray(devices, dtype=object).reshape(shape)
-        return cls(topology=topology, mesh=Mesh(dev_array, names), model_axes=model_axes)
+        return cls(
+            topology=topology,
+            mesh=Mesh(dev_array, names),
+            model_axes=model_axes,
+            manual_model_axes=manual_model_axes,
+        )
 
     @property
     def axis_names(self) -> tuple[str, ...]:
         return self.topology.axis_names
 
     def manual_axes(self) -> frozenset[str] | None:
-        """Axes ``shard_map`` should be manual over: the worker axes when a
-        model submesh exists (partial-manual), else None (fully manual)."""
-        return frozenset(self.axis_names) if self.model_axes else None
+        """Axes ``shard_map`` should be manual over: worker axes plus any
+        manual model axes (e.g. ``pp``) when a model submesh exists
+        (partial-manual), else None (fully manual)."""
+        if not self.model_axes:
+            return None
+        return frozenset(self.axis_names) | frozenset(self.manual_model_axes)
 
     def worker_spec(self) -> PartitionSpec:
         """PartitionSpec sharding the leading worker axes over the mesh."""

@@ -618,8 +618,10 @@ class ConsensusEngine:
         return int(payload * sends + mass)
 
     # ---- metrics --------------------------------------------------------
-    def consensus_error_collective(self, params: Any) -> jax.Array:
-        return collectives.consensus_error(params, self.topology)
+    def consensus_error_collective(
+        self, params: Any, shard_axes: tuple[str, ...] = ()
+    ) -> jax.Array:
+        return collectives.consensus_error(params, self.topology, shard_axes)
 
     def consensus_error_simulated(self, params: Any) -> jax.Array:
         return simulated.consensus_error_stacked(params, self.topology.world_size)

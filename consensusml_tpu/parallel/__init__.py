@@ -24,5 +24,6 @@ from consensusml_tpu.parallel.sharding import (  # noqa: F401
     gpt2_tp_rules,
     llama_tp_rules,
     moe_ep_rules,
+    pipeline_pp_rules,
     spec_for_path,
 )

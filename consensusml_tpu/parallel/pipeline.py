@@ -36,12 +36,19 @@ import jax.numpy as jnp
 __all__ = ["pipeline_apply", "pipeline_last_stage_mean"]
 
 
-def _varying(x: jax.Array, axis_name: str) -> jax.Array:
-    """Mark ``x`` device-varying along ``axis_name`` (VMA annotation)."""
+def _vma(x) -> frozenset:
+    return frozenset(getattr(jax.typeof(x), "vma", ()))
+
+
+def _varying(x: jax.Array, axes) -> jax.Array:
+    """Mark ``x`` device-varying along ``axes`` it isn't already (VMA)."""
+    missing = tuple(sorted(frozenset(axes) - _vma(x)))
+    if not missing:
+        return x
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return jax.lax.pcast(x, missing, to="varying")
     if hasattr(jax.lax, "pvary"):
-        return jax.lax.pvary(x, axis_name)
+        return jax.lax.pvary(x, missing)
     return x
 
 
@@ -87,7 +94,14 @@ def pipeline_apply(
         act_out = jax.lax.ppermute(y, axis_name, perm)
         return (outs, act_out), None
 
-    x0 = _varying(microbatches[0], axis_name)
+    # the scan carries must enter with the SAME varying-manual-axes set the
+    # tick body produces: {axis_name} for the ppermute, plus whatever the
+    # params/microbatches are already varying over (the gossip worker axes
+    # when pipelining runs inside the composed gossip-DP shard_map)
+    varying_axes = {axis_name} | _vma(microbatches)
+    for leaf in jax.tree.leaves(stage_params):
+        varying_axes |= _vma(leaf)
+    x0 = _varying(microbatches[0], varying_axes)
     y_shape = jax.eval_shape(stage_fn, stage_params, x0)
     if y_shape.shape != x0.shape:
         raise ValueError(
@@ -97,8 +111,8 @@ def pipeline_apply(
     outs0 = jnp.zeros((m,) + x0.shape, y_shape.dtype)
     act0 = jnp.zeros(x0.shape, y_shape.dtype)
     # carries must already be device-varying before the first ppermute
-    outs0 = _varying(outs0, axis_name)
-    act0 = _varying(act0, axis_name)
+    outs0 = _varying(outs0, varying_axes)
+    act0 = _varying(act0, varying_axes)
     (outs, _), _ = jax.lax.scan(tick, (outs0, act0), jnp.arange(ticks))
     return outs
 

@@ -33,29 +33,42 @@ __all__ = [
     "moe_ep_rules",
 ]
 
-# (path regex, trailing-dim partition spec) — axis names must exist on the
-# WorkerMesh's model axes.
-ShardingRules = Sequence[tuple[str, tuple[str | None, ...]]]
+# (path regex, partition spec) pairs — axis names must exist on the
+# WorkerMesh's model axes. A spec applies to the TRAILING dims; an
+# optional third element "lead" applies it to the LEADING (post-stack)
+# dims instead — the natural form for layer-stacked pipeline params,
+# whose stage dim is dim 0 at EVERY leaf rank.
+ShardingRules = Sequence[tuple]
 
 
 def spec_for_path(
     path: str, ndim: int, rules: ShardingRules | None
 ) -> tuple[str | None, ...]:
-    """Trailing-dim spec for one leaf: first matching rule, else replicated.
+    """Per-dim spec for one leaf: first matching rule, else replicated.
 
-    A rule's spec applies to the LAST ``len(spec)`` dims; a spec longer
-    than the leaf's rank is an error (catches rules written for the wrong
-    tensor).
+    A rule's spec applies to the LAST ``len(spec)`` dims ("lead" rules:
+    the FIRST); a spec longer than the leaf's rank is an error (catches
+    rules written for the wrong tensor).
     """
     if rules:
-        for pattern, spec in rules:
+        for rule in rules:
+            pattern, spec = rule[0], rule[1]
+            if len(rule) > 2 and rule[2] != "lead":
+                # a typo'd marker silently becoming a trailing rule would
+                # shard the wrong dim — e.g. a bias's feature dim over pp
+                raise ValueError(
+                    f"rule {pattern!r}: third element must be 'lead', "
+                    f"got {rule[2]!r}"
+                )
+            lead = len(rule) > 2
             if re.search(pattern, path):
                 if len(spec) > ndim:
                     raise ValueError(
                         f"sharding rule {pattern!r} wants {len(spec)} dims but "
                         f"leaf {path!r} has only {ndim}"
                     )
-                return (None,) * (ndim - len(spec)) + tuple(spec)
+                pad = (None,) * (ndim - len(spec))
+                return tuple(spec) + pad if lead else pad + tuple(spec)
     return (None,) * ndim
 
 
@@ -87,6 +100,17 @@ def stacked_shardings(
 # ---------------------------------------------------------------------------
 # stock rule sets (Megatron-style 1-D tensor parallelism)
 # ---------------------------------------------------------------------------
+
+
+def pipeline_pp_rules(
+    axis: str = "pp", pattern: str = r"stages/"
+) -> ShardingRules:
+    """Stage-stacked pipeline params: every leaf under ``pattern`` carries
+    its layer/stage dim FIRST (after the worker stack axis), whatever its
+    rank — one "lead" rule covers kernels and biases alike. Used with
+    ``WorkerMesh(model_axes=(("pp", P),), manual_model_axes=("pp",))`` and
+    a loss_fn built on :func:`~consensusml_tpu.parallel.pipeline_apply`."""
+    return [(pattern, (axis,), "lead")]
 
 
 def llama_tp_rules(axis: str = "tp") -> ShardingRules:

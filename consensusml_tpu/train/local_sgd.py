@@ -197,7 +197,7 @@ def _unsqueeze(tree: Any, n_axes: int) -> Any:
 
 
 def make_collective_train_step(
-    cfg: LocalSGDConfig, loss_fn: LossFn, wmesh: WorkerMesh
+    cfg: LocalSGDConfig, loss_fn: LossFn, wmesh: WorkerMesh, rules=None
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted global train step for a device mesh.
 
@@ -211,6 +211,25 @@ def make_collective_train_step(
     layout-preserving (no data movement). Returns ``(new_state, metrics)``
     with replicated scalar metrics: mean loss and post-gossip consensus
     error — the reference's headline pair.
+
+    ``rules`` (a :mod:`consensusml_tpu.parallel.sharding` rule list) is
+    required when ``wmesh`` has MANUAL model axes (pipeline parallelism):
+    the rules say which state dims are sharded over those axes, so the
+    step can build per-leaf ``shard_map`` specs — e.g.
+    ``pipeline_pp_rules()`` for a loss_fn built on ``pipeline_apply``
+    whose stage-stacked params live under ``stages/``. The loss_fn must
+    return a loss replicated over the manual model axes (use
+    ``pipeline_last_stage_mean``). Gossip then exchanges each device's
+    layer shard with the same stage of neighboring workers — stage-local
+    traffic, no pp-axis gather.
+
+    Compressed gossip under PP is STAGE-LOCAL: each device runs the codec
+    on its own layer shard. Chunk-local codecs (``ChunkedTopKCompressor``
+    with the chunk dividing the per-stage leaf size) are therefore
+    bit-identical to the unsharded semantics; a global-per-leaf top-k
+    (``TopKCompressor``) selects per shard instead, which changes WHICH
+    elements ship (still contractive, just not oracle-identical — the
+    cross-backend test pins the chunk-aligned case).
     """
     engine = cfg.engine()
     topo = wmesh.topology
@@ -229,20 +248,35 @@ def make_collective_train_step(
     # With a model submesh (WorkerMesh.model_axes), shard_map goes
     # partial-manual: gossip axes are manual (ppermute/psum written here),
     # model axes stay auto — XLA inserts the intra-worker tensor-parallel
-    # collectives from the param sharding annotations.
+    # collectives from the param sharding annotations. Axes listed in
+    # manual_model_axes (pp) are ALSO manual: their collectives live in
+    # the loss_fn (pipeline_apply's stage ppermute), and state leaves are
+    # sharded over them per `rules` (handled below via per-leaf specs).
     manual = wmesh.manual_axes()
     shard_kwargs = {} if manual is None else {"axis_names": manual}
+    mm_axes = tuple(wmesh.manual_model_axes)
+    if mm_axes:
+        unsupported = [
+            name
+            for name, on in [
+                ("overlap gossip", cfg.gossip.overlap),
+                ("fault injection", cfg.gossip.faults is not None),
+                ("SlowMo outer", cfg.outer is not None),
+            ]
+            if on
+        ]
+        if unsupported:
+            # each needs a per-worker scalar consistent ACROSS the model
+            # shards (alive flags / finite checks / outer momentum norms)
+            # — composable later, rejected loudly now
+            raise NotImplementedError(
+                f"{', '.join(unsupported)} not supported with manual model "
+                f"axes {mm_axes} (pipeline-parallel workers)"
+            )
     faults = cfg.gossip.faults
     comp = cfg.gossip.compressor
     stochastic_comp = comp is not None and comp.stochastic
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=wmesh.mesh,
-        in_specs=(worker, worker),
-        out_specs=(worker, P()),
-        **shard_kwargs,
-    )
     def sharded_round(state: TrainState, batch: Any):
         state = _squeeze(state, n_axes)
         batch = _squeeze(batch, n_axes)
@@ -316,7 +350,7 @@ def make_collective_train_step(
         outer = state.outer
         if cfg.outer is not None:
             params, outer = slowmo_update(cfg.outer, params, outer)
-        err = engine.consensus_error_collective(params)
+        err = engine.consensus_error_collective(params, shard_axes=mm_axes)
         new_state = TrainState(
             step=state.step + 1,
             params=params,
@@ -336,23 +370,98 @@ def make_collective_train_step(
 
     # donate the old TrainState so XLA updates params/opt buffers in place —
     # without this every round copies the full replica set through HBM
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def jitted_step(state: TrainState, batch: Any):
-        new_state, metrics = sharded_round(to_mesh(state), to_mesh(batch))
-        return to_flat(new_state), metrics
+    def _wrap(sharded):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def jitted_step(state: TrainState, batch: Any):
+            new_state, metrics = sharded(to_mesh(state), to_mesh(batch))
+            return to_flat(new_state), metrics
 
-    if manual is None:
         return jitted_step
 
-    def train_step(state: TrainState, batch: Any):
-        # auto-axis sharding propagation needs the ambient mesh set
-        with jax.sharding.set_mesh(wmesh.mesh):
-            return jitted_step(state, batch)
+    if not mm_axes:
+        jitted_step = _wrap(
+            jax.shard_map(
+                sharded_round,
+                mesh=wmesh.mesh,
+                in_specs=(worker, worker),
+                out_specs=(worker, P()),
+                **shard_kwargs,
+            )
+        )
+        if manual is None:
+            return jitted_step
 
-    # the underlying jit object, for .lower()/AOT inspection (full-scale
-    # shape smoke tests trace without executing); callers must set the
-    # ambient mesh themselves when using it directly
-    train_step._jitted = jitted_step
+        def train_step(state: TrainState, batch: Any):
+            # auto-axis sharding propagation needs the ambient mesh set
+            with jax.sharding.set_mesh(wmesh.mesh):
+                return jitted_step(state, batch)
+
+        # the underlying jit object, for .lower()/AOT inspection (full-scale
+        # shape smoke tests trace without executing); callers must set the
+        # ambient mesh themselves when using it directly
+        train_step._jitted = jitted_step
+        return train_step
+
+    # ---- manual model axes (pipeline-parallel workers) ------------------
+    # shard_map specs must spell out which state dims ride the manual
+    # model axes (there is no auto mode to infer them), and those dims
+    # are per-leaf (stage-stacked kernels vs per-worker scalars), so the
+    # specs come from `rules` and the concrete state/batch structure —
+    # built lazily on first call and cached by tree structure.
+    from consensusml_tpu.parallel.sharding import spec_for_path
+
+    if rules is None:
+        raise ValueError(
+            f"manual model axes {mm_axes} need sharding `rules` naming the "
+            "state dims that ride them (e.g. pipeline_pp_rules() for "
+            "stage-stacked params under 'stages/'); without rules every "
+            "leaf would silently replicate over the pipeline axis"
+        )
+
+    def specs_for(tree, expect_manual=False):
+        hits = [0]
+
+        def one(path, leaf):
+            pathstr = jax.tree_util.keystr(path, simple=True, separator="/")
+            tail = spec_for_path(pathstr, leaf.ndim - 1, rules)
+            # auto model axes (tp) stay out of manual specs — XLA carries
+            # them through the arrays' own shardings
+            tail = tuple(a if a in mm_axes else None for a in tail)
+            hits[0] += any(a is not None for a in tail)
+            return P(*topo.axis_names, *tail)
+
+        specs = jax.tree.map_with_path(one, tree)
+        if expect_manual and not hits[0]:
+            raise ValueError(
+                f"no state leaf matched the sharding rules for manual model "
+                f"axes {mm_axes} — the stage-stacked params would replicate "
+                "over the pipeline axis; check the rule patterns against "
+                "the param paths"
+            )
+        return specs
+
+    cache: dict = {}
+
+    def train_step(state: TrainState, batch: Any):
+        ranks = lambda t: tuple(x.ndim for x in jax.tree.leaves(t))
+        key = (
+            jax.tree.structure(state), ranks(state),
+            jax.tree.structure(batch), ranks(batch),
+        )
+        if key not in cache:
+            state_specs = specs_for(state, expect_manual=True)
+            cache[key] = _wrap(
+                jax.shard_map(
+                    sharded_round,
+                    mesh=wmesh.mesh,
+                    in_specs=(state_specs, specs_for(batch)),
+                    out_specs=(state_specs, P()),
+                    **shard_kwargs,
+                )
+            )
+        with jax.sharding.set_mesh(wmesh.mesh):
+            return cache[key](state, batch)
+
     return train_step
 
 

@@ -47,6 +47,21 @@ _CHILD = (
 
 TPU_PLATFORMS = ("tpu", "axon")
 
+# the live probe child, so a caller's signal handler can reap it — a
+# probe against a wedged tunnel is a python process hung in
+# jax.devices() forever, and orphaning it would keep the tunnel held
+_active_child: subprocess.Popen | None = None
+
+
+def kill_active_probe() -> None:
+    """Kill the in-flight probe child, if any (signal-handler safe)."""
+    proc = _active_child
+    if proc is not None:
+        try:
+            proc.kill()
+        except Exception:
+            pass
+
 
 def probe(timeout: float | None = None) -> dict:
     """Probe backend liveness in a subprocess; never hangs the caller.
@@ -70,29 +85,36 @@ def probe(timeout: float | None = None) -> dict:
         "device_kind": "",
         "timeout_s": timeout,
     }
+    global _active_child
     t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    _active_child = proc
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", child],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
         out["elapsed_s"] = round(time.time() - t0, 1)
         out["reason"] = (
             f"backend probe did not return within {timeout:.0f}s — "
             "jax.devices() is hanging (wedged TPU tunnel?)"
         )
         return out
+    finally:
+        _active_child = None
     out["elapsed_s"] = round(time.time() - t0, 1)
     if proc.returncode != 0:
         out["reason"] = (
             f"backend probe exited rc={proc.returncode}: "
-            f"{proc.stderr.strip()[-400:]}"
+            f"{stderr.strip()[-400:]}"
         )
         return out
-    for line in proc.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith(_MARK):
             try:
                 info = json.loads(line[len(_MARK):])
@@ -103,7 +125,7 @@ def probe(timeout: float | None = None) -> dict:
             out["alive"] = True
             out["tpu"] = out.get("platform") in TPU_PLATFORMS
             return out
-    out["reason"] = f"probe printed no {_MARK.strip()} line: {proc.stdout[-200:]}"
+    out["reason"] = f"probe printed no {_MARK.strip()} line: {stdout[-200:]}"
     return out
 
 

@@ -196,7 +196,7 @@ def variants(wl, args):
         ),
         "choco topk+int8": choco(topk_int8_compressor(**ca)),
         "choco topk+int4": choco(topk_int4_compressor(**ca)),
-        "choco qsgd4": choco(QSGD4Compressor(chunk=128)),
+        "choco qsgd4": choco(QSGD4Compressor(chunk=ca["chunk"])),
         "push-sum one-peer (directed)": LocalSGDConfig(
             gossip=GossipConfig(
                 topology=OnePeerExponentialTopology(world), push_sum=True
@@ -326,6 +326,9 @@ def main() -> None:
     ap.add_argument("--h-sweep", action="store_true")
     ap.add_argument("--gamma-sweep", action="store_true")
     ap.add_argument("--modes", default=None, help="comma substrings to keep")
+    ap.add_argument("--codec-k", type=int, default=None,
+                    help="override the workload codec's k (top-k per chunk) — "
+                         "the lm_full frontier sweep's sparsity axis")
     ap.add_argument(
         "--device",
         choices=("cpu", "tpu"),
@@ -343,6 +346,10 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     wl = build_workload(args.workload, args.noise, args.batch)
+    if args.codec_k is not None:
+        if "codec" not in wl:
+            raise SystemExit("--codec-k only applies to workloads with a pinned codec (lm_full)")
+        wl["codec"] = dict(wl["codec"], k=args.codec_k)
     rows = {}
     for name, cfg in variants(wl, args).items():
         rows[name] = run_variant(cfg, wl, args.rounds)

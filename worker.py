@@ -83,14 +83,21 @@ def _prevalidate_rendezvous(
                     conn, _ = srv.accept()
                 except socket.timeout:
                     continue
-                conn.settimeout(5.0)
                 try:
                     # newline-framed: a single recv can return a FRAGMENT
                     # of the peer's JSON (then parsed as invalid and the
                     # peer misdiagnosed as a stray connection) — read
-                    # until the delimiter, EOF, or a size cap
+                    # until the delimiter, EOF, or a size cap, with a
+                    # PER-CONNECTION deadline so a byte-dribbling prober
+                    # can't stall the whole rendezvous (each recv resets
+                    # a plain socket timeout; the deadline does not)
+                    conn_deadline = time.monotonic() + 5.0
                     buf = b""
                     while b"\n" not in buf and len(buf) < 4096:
+                        left = conn_deadline - time.monotonic()
+                        if left <= 0:
+                            raise socket.timeout("pre-check read deadline")
+                        conn.settimeout(left)
                         part = conn.recv(256)
                         if not part:
                             break
