@@ -106,8 +106,31 @@ class GossipConfig:
     # x <- W x - lr g(x) (Sayed, "Adaptation, Learning, and Optimization
     # over Networks", 2014), so standard convergence results apply.
     overlap: bool = False
+    # Consensus iterations per round. CHOCO's stable consensus step
+    # size shrinks with the compression ratio (the r4 frontier study:
+    # at 30M params the shipped 1/64 codec diverges at gamma 0.5 and
+    # merely plateaus-at-chance at gamma 0.1 — docs/convergence.md);
+    # running T iterations at a SMALL gamma multiplies the per-round
+    # contraction (~(1 - c*gamma*omega)^T) while every iteration stays
+    # inside the stability region. Each iteration re-compresses the
+    # current innovation and ships a fresh payload, so wire bytes per
+    # round multiply by T (wire_bytes_per_round accounts for it).
+    gossip_steps: int = 1
 
     def __post_init__(self):
+        if self.gossip_steps < 1:
+            raise ValueError(f"gossip_steps must be >= 1, got {self.gossip_steps}")
+        if self.gossip_steps > 1 and self.push_sum:
+            raise NotImplementedError(
+                "gossip_steps > 1 with push-sum is not supported: the mass "
+                "ratio's bias correction is defined per round, not per "
+                "inner consensus iteration"
+            )
+        if self.gossip_steps > 1 and self.overlap:
+            raise NotImplementedError(
+                "gossip_steps > 1 with overlap gossip is not supported: "
+                "the delayed correction is computed once per round"
+            )
         if self.fused_codec and self.compressor is None:
             raise NotImplementedError(
                 "fused_codec without a compressor has nothing to fuse: "
@@ -354,6 +377,7 @@ class ConsensusEngine:
                 mixed, new_state = pushsum_round_collective(sel, state, topo, alive)
                 return rebuild(mixed), new_state
             return pushsum_round_collective(params, state, topo, alive)
+        n_iter = self.config.gossip_steps
         if not self.compressed:
             flt = self.config.path_filter
             if alive is not None:
@@ -374,13 +398,14 @@ class ConsensusEngine:
                 mix_one = lambda x: collectives.mix(x, topo)
                 mix_all = lambda t: collectives.mix_tree(t, topo)
             if flt is not None:
-                return (
-                    jax.tree_util.tree_map_with_path(
+                for _ in range(n_iter):
+                    params = jax.tree_util.tree_map_with_path(
                         lambda p, x: mix_one(x) if flt(p) else x, params
-                    ),
-                    None,
-                )
-            return mix_all(params), None
+                    )
+                return params, None
+            for _ in range(n_iter):
+                params = mix_all(params)
+            return params, None
 
         comp = self.config.compressor
         # one partition over the original paths: CHOCO leaves / exact-mix
@@ -389,7 +414,9 @@ class ConsensusEngine:
             params
         )
         if exact_leaves is not None:
-            mixed_exact = [collectives.mix(x, topo) for x in exact_leaves]
+            mixed_exact = exact_leaves
+            for _ in range(n_iter):  # stay in step with the CHOCO leaves
+                mixed_exact = [collectives.mix(x, topo) for x in mixed_exact]
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
@@ -397,26 +424,40 @@ class ConsensusEngine:
             # one compress/decompress over the concatenated tree instead
             # of ~3 kernel launches per leaf (see GossipConfig.fused_codec)
             x, unravel = _ravel_tree(x)
-        delta = jax.tree.map(jnp.subtract, x, state.xhat)
-        q = comp.compress_tree(delta, rng)
-        dec_q = comp.decompress_tree(q, like=delta)
-        xhat = jax.tree.map(jnp.add, state.xhat, dec_q)
-
-        if topo.uses_psum:
-            recv = jax.tree.map(
-                lambda d: jax.lax.pmean(d, topo.axis_names), dec_q
+        xhat, s = state.xhat, state.s
+        # T consensus iterations, each re-compressing the CURRENT
+        # innovation (CHOCO-Gossip run T times — see gossip_steps)
+        for it in range(n_iter):
+            it_rng = (
+                rng
+                if n_iter == 1
+                else (None if rng is None else jax.random.fold_in(rng, it))
             )
-        else:
-            recv = jax.tree.map(lambda d: topo.self_weight * d, dec_q)
-            for shift in topo.shifts:
-                q_nbr = collectives.ppermute_shift_tree(q, topo, shift)
-                # fused decompress-accumulate: sparse codecs scatter-add
-                # straight into recv — no dense per-neighbor temporary
-                recv = comp.decompress_accumulate_tree(q_nbr, recv, shift.weight)
-        s = jax.tree.map(jnp.add, state.s, recv)
-        x_new = jax.tree.map(
-            lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat
-        )
+            delta = jax.tree.map(jnp.subtract, x, xhat)
+            q = comp.compress_tree(delta, it_rng)
+            dec_q = comp.decompress_tree(q, like=delta)
+            xhat = jax.tree.map(jnp.add, xhat, dec_q)
+
+            if topo.uses_psum:
+                recv = jax.tree.map(
+                    lambda d: jax.lax.pmean(d, topo.axis_names), dec_q
+                )
+            else:
+                recv = jax.tree.map(lambda d: topo.self_weight * d, dec_q)
+                for shift in topo.shifts:
+                    q_nbr = collectives.ppermute_shift_tree(q, topo, shift)
+                    # fused decompress-accumulate: sparse codecs
+                    # scatter-add straight into recv — no dense
+                    # per-neighbor temporary
+                    recv = comp.decompress_accumulate_tree(
+                        q_nbr, recv, shift.weight
+                    )
+            s = jax.tree.map(jnp.add, s, recv)
+            x = jax.tree.map(
+                lambda xi, si, hi: xi + self.config.gamma * (si - hi),
+                x, s, xhat,
+            )
+        x_new = x
         if unravel is not None:
             x_new = unravel(x_new)
         x_new = jax.tree.map(
@@ -501,6 +542,7 @@ class ConsensusEngine:
         ``(world,)`` keys for stochastic codecs — the same per-worker draws
         the collective backend makes.
         """
+        n_iter = self.config.gossip_steps
         if self.config.push_sum:
             if self.config.path_filter is not None:
                 sel, rebuild = self._select(params)
@@ -512,14 +554,15 @@ class ConsensusEngine:
                 w = masked_mixing_matrix(w, alive)
             flt = self.config.path_filter
             if flt is not None:
-                return (
-                    jax.tree_util.tree_map_with_path(
+                for _ in range(n_iter):
+                    params = jax.tree_util.tree_map_with_path(
                         lambda p, x: simulated.mix_stacked(x, w) if flt(p) else x,
                         params,
-                    ),
-                    None,
-                )
-            return simulated.mix_tree_stacked(params, w), None
+                    )
+                return params, None
+            for _ in range(n_iter):
+                params = simulated.mix_tree_stacked(params, w)
+            return params, None
 
         comp = self.config.compressor
         # same partition as the collective backend (original paths)
@@ -527,7 +570,9 @@ class ConsensusEngine:
             params
         )
         if exact_leaves is not None:
-            mixed_exact = [simulated.mix_stacked(x, w) for x in exact_leaves]
+            mixed_exact = exact_leaves
+            for _ in range(n_iter):  # stay in step with the CHOCO leaves
+                mixed_exact = [simulated.mix_stacked(x, w) for x in mixed_exact]
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
@@ -535,28 +580,38 @@ class ConsensusEngine:
             # same flatten boundary as the collective backend: per-worker
             # rows (W, n), compress vmapped over the worker axis below
             x, unravel = _ravel_tree(x, stacked=True)
-        delta = jax.tree.map(jnp.subtract, x, state.xhat)
-        # vmap the SAME compress_tree/decompress_tree path the collective
-        # backend runs, so the per-leaf rng fold-in convention has one
-        # source of truth and the backends draw identical randomness
-        if comp.stochastic:
-            if rng is None:
-                raise ValueError(
-                    f"{type(comp).__name__} is stochastic and needs stacked rng"
+        xhat, s = state.xhat, state.s
+        for it in range(n_iter):
+            delta = jax.tree.map(jnp.subtract, x, xhat)
+            # vmap the SAME compress_tree/decompress_tree path the
+            # collective backend runs, so the per-leaf rng fold-in
+            # convention has one source of truth and the backends draw
+            # identical randomness (incl. the per-iteration fold)
+            if comp.stochastic:
+                if rng is None:
+                    raise ValueError(
+                        f"{type(comp).__name__} is stochastic and needs stacked rng"
+                    )
+                it_rng = (
+                    rng
+                    if n_iter == 1
+                    else jax.vmap(lambda k: jax.random.fold_in(k, it))(rng)
                 )
-            dec_q = jax.vmap(
-                lambda t, k: comp.decompress_tree(comp.compress_tree(t, k), like=t)
-            )(delta, rng)
-        else:
-            dec_q = jax.vmap(
-                lambda t: comp.decompress_tree(comp.compress_tree(t), like=t)
-            )(delta)
-        xhat = jax.tree.map(jnp.add, state.xhat, dec_q)
-        recv = simulated.mix_tree_stacked(dec_q, w)
-        s = jax.tree.map(jnp.add, state.s, recv)
-        x_new = jax.tree.map(
-            lambda xi, si, hi: xi + self.config.gamma * (si - hi), x, s, xhat
-        )
+                dec_q = jax.vmap(
+                    lambda t, k: comp.decompress_tree(comp.compress_tree(t, k), like=t)
+                )(delta, it_rng)
+            else:
+                dec_q = jax.vmap(
+                    lambda t: comp.decompress_tree(comp.compress_tree(t), like=t)
+                )(delta)
+            xhat = jax.tree.map(jnp.add, xhat, dec_q)
+            recv = simulated.mix_tree_stacked(dec_q, w)
+            s = jax.tree.map(jnp.add, s, recv)
+            x = jax.tree.map(
+                lambda xi, si, hi: xi + self.config.gamma * (si - hi),
+                x, s, xhat,
+            )
+        x_new = x
         if unravel is not None:
             x_new = unravel(x_new)
         x_new = jax.tree.map(lambda new, old: new.astype(old.dtype), x_new, params)
@@ -615,7 +670,8 @@ class ConsensusEngine:
         else:
             sends = 1 if topo.uses_psum else len(topo.shifts)
         mass = 4 * sends if self.config.push_sum else 0
-        return int(payload * sends + mass)
+        # every extra consensus iteration ships a fresh payload
+        return int(payload * sends * self.config.gossip_steps + mass)
 
     # ---- metrics --------------------------------------------------------
     def consensus_error_collective(

@@ -378,3 +378,123 @@ def test_compress_filter_composes_with_path_filter():
         np.asarray(simulated.mix_stacked(tree["model_state"]["var"], w)),
         rtol=1e-6, atol=1e-6,
     )
+
+
+def test_gossip_steps_multiplies_contraction():
+    """T consensus iterations per round contract like T single rounds
+    (exact mixing: x -> W^T x), cross-backend, and wire accounting
+    multiplies by T."""
+    import numpy as np
+
+    from consensusml_tpu.comm.simulated import mixing_matrix
+    from consensusml_tpu.compress import topk_int8_compressor
+
+    world = 8
+    topo = RingTopology(world)
+    w = mixing_matrix(topo)
+    rng = np.random.default_rng(0)
+    x = {"a": jnp.asarray(rng.normal(size=(world, 64)), jnp.float32)}
+
+    e1 = ConsensusEngine(GossipConfig(topology=topo))
+    e3 = ConsensusEngine(GossipConfig(topology=topo, gossip_steps=3))
+    y1, _ = e1.round_simulated(x, None, w)
+    y111, _ = e1.round_simulated(y1, None, w)
+    y111, _ = e1.round_simulated(y111, None, w)
+    y3, _ = e3.round_simulated(x, None, w)
+    np.testing.assert_allclose(
+        np.asarray(y3["a"]), np.asarray(y111["a"]), rtol=1e-5, atol=1e-6
+    )
+
+    # CHOCO: T iterations contract consensus error strictly more than 1
+    comp = topk_int8_compressor(ratio=0.25, chunk=32)
+    err = lambda v: float(
+        np.sqrt(np.mean(np.sum((v - v.mean(0)) ** 2, axis=-1)))
+    )
+    for steps, expect_better in [(1, None), (4, True)]:
+        eng = ConsensusEngine(
+            GossipConfig(topology=topo, compressor=comp, gamma=0.2,
+                         gossip_steps=steps)
+        )
+        st = eng.init_state(x, world_size=world)
+        v = dict(x)
+        for _ in range(5):
+            v, st = eng.round_simulated(v, st, w)
+        e = err(np.asarray(v["a"]))
+        if steps == 1:
+            e_single = e
+        else:
+            assert e < 0.5 * e_single, (e, e_single)
+
+    # wire accounting multiplies by T
+    p = {"a": jnp.zeros((512,), jnp.float32)}
+    w1 = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.2)
+    ).wire_bytes_per_round(p)
+    w4 = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.2, gossip_steps=4)
+    ).wire_bytes_per_round(p)
+    assert w4 == 4 * w1
+
+
+def test_gossip_steps_collective_matches_simulated():
+    """gossip_steps > 1 stays cross-validated between backends (CHOCO)."""
+    topo = RingTopology(8)
+    comp = topk_int8_compressor(ratio=0.25, chunk=32)
+    engine = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.3, gossip_steps=3)
+    )
+    stacked = _params(topo)
+    got = _run_collective(engine, stacked, rounds=2)
+    want = _run_simulated(engine, stacked, rounds=2)
+    for key in stacked:
+        np.testing.assert_allclose(got[key], want[key], rtol=2e-5, atol=1e-6)
+
+
+def test_gossip_steps_stochastic_codec_backends_agree():
+    """The PER-ITERATION rng fold (gossip_steps > 1 + stochastic codec)
+    must draw identical randomness on both backends — the deterministic
+    topk test above cannot catch a fold-convention divergence."""
+    import functools
+
+    from consensusml_tpu.compress import QSGD4Compressor
+
+    topo = RingTopology(4)
+    comp = QSGD4Compressor(chunk=32)
+    assert comp.stochastic
+    engine = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.3, gossip_steps=3)
+    )
+    rng = np.random.default_rng(5)
+    stacked = {
+        "a": jnp.asarray(rng.normal(size=(4, 64)), jnp.float32),
+    }
+    keys = jax.random.split(jax.random.key(7), 4)
+
+    # simulated
+    st = engine.init_state(stacked, world_size=4)
+    sim, _ = engine.round_simulated(stacked, st, simulated.mixing_matrix(topo), rng=keys)
+
+    # collective
+    wmesh = WorkerMesh.create(topo, platform="cpu")
+    blocked = jax.tree.map(
+        lambda v: jax.device_put(v, wmesh.stacked_sharding()), stacked
+    )
+    bkeys = jax.device_put(keys, wmesh.stacked_sharding())
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=wmesh.mesh,
+        in_specs=(P(*topo.axis_names), P(*topo.axis_names)),
+        out_specs=P(*topo.axis_names),
+    )
+    def run(tree, k):
+        sq = lambda t: jax.tree.map(lambda v: v.reshape(v.shape[1:]), t)
+        state = engine.init_state(sq(tree))
+        out, _ = engine.round_collective(sq(tree), state, rng=sq({"k": k})["k"])
+        return jax.tree.map(lambda v: v.reshape((1,) + v.shape), out)
+
+    col = run(blocked, bkeys)
+    np.testing.assert_allclose(
+        np.asarray(col["a"]), np.asarray(sim["a"]), rtol=2e-5, atol=1e-6
+    )

@@ -63,6 +63,7 @@ def build_workload(name: str, noise: float | None, batch: int | None):
             "eval_fn": classification_eval_fn(model),
             "data": data,
             "opt": lambda: __import__("optax").sgd(0.05),
+            "opt_factory": lambda lr: __import__("optax").sgd(lr),
             "scale": 1.0,
             "holdout": 512,
             "eval_batch": 64,
@@ -94,6 +95,7 @@ def build_workload(name: str, noise: float | None, batch: int | None):
             "eval_fn": causal_lm_eval_fn(model),
             "data": data,
             "opt": lambda: optax.adam(1e-3),
+            "opt_factory": lambda lr: optax.adam(lr),
             "scale": 1.0,
             "holdout": None,  # LM eval batches come from the keyed stream
             "eval_batch": 64,
@@ -130,11 +132,55 @@ def build_workload(name: str, noise: float | None, batch: int | None):
             "eval_fn": causal_lm_eval_fn(model),
             "data": data,
             "opt": lambda: optax.adam(6e-4),
+            "opt_factory": lambda lr: optax.adam(lr),
             "scale": 1.0,
             "holdout": None,
             "eval_batch": 16,
             # the SHIPPED full-scale codec parameters (ratio 1/64)
             "codec": {"chunk": 512, "k": 8},
+        }
+    if name == "bert32":
+        # VERDICT r3 item 3: config 3's advertised scale is 32-WORKER
+        # local-SGD (H=8) and the headline metric names 32-worker gossip,
+        # but every recorded trajectory so far ran 8 workers. This
+        # workload records the world=32 story on the simulated backend:
+        # a mid-size BERT (~8M params — world size, not model size, is
+        # the axis under test; 32 full BERT-base replicas would blow one
+        # chip's HBM), H=8 periodic averaging, masked-LM eval. Run with
+        # --torus for the 4x8 torus row next to the ring.
+        import optax
+
+        from consensusml_tpu.data import SyntheticLM
+        from consensusml_tpu.models.bert import (
+            BertConfig,
+            BertMLM,
+            bert_mlm_loss_fn,
+        )
+        from consensusml_tpu.train import mlm_eval_fn
+
+        model = BertMLM(
+            config=BertConfig(
+                vocab_size=8192, hidden=256, layers=4, heads=8,
+                mlp_dim=1024, max_len=128, dropout=0.0,
+            )
+        )
+        data = SyntheticLM(vocab_size=8192, seq_len=128)
+        return {
+            "world": 32,
+            "h": 8,  # config 3's recipe: H=8 + periodic averaging
+            "batch": batch or 8,
+            "loss_fn": bert_mlm_loss_fn(model),
+            "init": lambda r: model.init(r, jnp.zeros((1, 128), jnp.int32))[
+                "params"
+            ],
+            "eval_fn": mlm_eval_fn(model),
+            "data": data,
+            "opt": lambda: optax.adam(3e-4),
+            "opt_factory": lambda lr: optax.adam(lr),
+            "scale": 1.0,
+            "holdout": None,
+            "eval_batch": 16,
+            "mlm_rate": 0.15,
         }
     if name == "resnet":
         from consensusml_tpu.models import resnet50, resnet_init, resnet_loss_fn
@@ -153,6 +199,7 @@ def build_workload(name: str, noise: float | None, batch: int | None):
             "eval_fn": classification_eval_fn(model, train_kwarg=True),
             "data": data,
             "opt": lambda: __import__("optax").sgd(0.05, momentum=0.9),
+            "opt_factory": lambda lr: __import__("optax").sgd(lr, momentum=0.9),
             # raw inputs have std ~= noise; a uniform rescale keeps the
             # task identical but the conv stem numerically comfortable
             "scale": 1.0 / (1.0 + noise),
@@ -182,8 +229,11 @@ def variants(wl, args):
     # workload-specific codec parameters (lm_full pins the SHIPPED
     # full-scale k=8/512); default = the smoke-scale ratio-0.1 codec
     ca = wl.get("codec", {"ratio": 0.1, "chunk": 128})
+    gs = getattr(args, "gossip_steps", 1)
     choco = lambda comp, gamma=0.5, hh=h: LocalSGDConfig(  # noqa: E731
-        gossip=GossipConfig(topology=ring, compressor=comp, gamma=gamma),
+        gossip=GossipConfig(
+            topology=ring, compressor=comp, gamma=gamma, gossip_steps=gs
+        ),
         optimizer=tx(),
         h=hh,
     )
@@ -211,6 +261,13 @@ def variants(wl, args):
             outer=SlowMoConfig(beta=0.5),
         ),
     }
+    if args.torus:
+        from consensusml_tpu.topology import topology_from_name
+
+        tor = topology_from_name("torus", world)
+        out["exact torus"] = LocalSGDConfig(
+            gossip=GossipConfig(topology=tor), optimizer=tx(), h=h
+        )
     if args.h_sweep:
         for hh in H_SWEEP:
             if hh == h:
@@ -260,8 +317,11 @@ def run_variant(cfg, wl, rounds: int) -> dict:
     # equal tokens-seen across the h-sweep: fewer rounds at larger H so
     # every row consumes the same number of microbatches
     n_rounds = max(1, (rounds * wl["h"]) // cfg.h)
+    mlm_rate = wl.get("mlm_rate", 0.0)
     batches = (
-        lm_round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds)
+        lm_round_batches(
+            wl["data"], world, cfg.h, wl["batch"], n_rounds, mlm_rate=mlm_rate
+        )
         if is_lm
         else round_batches(wl["data"], world, cfg.h, wl["batch"], n_rounds)
     )
@@ -284,10 +344,17 @@ def run_variant(cfg, wl, rounds: int) -> dict:
     eb = wl["eval_batch"]
     if is_lm:
         # held-out LM windows: same keyed sample stream, disjoint seeds
+        # (MLM workloads corrupt them with the shared keyed masker)
         def eval_batches():
+            from consensusml_tpu.data.synthetic import mlm_corrupt
+
             for r in range(8):
                 rng = np.random.default_rng((999_983, r))
-                yield {"input_ids": jnp.asarray(wl["data"].sample(rng, (eb,)))}
+                ids = wl["data"].sample(rng, (eb,))
+                if mlm_rate > 0:
+                    yield mlm_corrupt(ids, wl["data"], 999_983, r, mlm_rate)
+                else:
+                    yield {"input_ids": jnp.asarray(ids)}
 
     else:
         held = wl["data"].holdout(wl["holdout"])
@@ -303,10 +370,15 @@ def run_variant(cfg, wl, rounds: int) -> dict:
     ev = evaluate(wl["eval_fn"], state, eval_batches())
     # classifiers report held-out top-1; LMs report held-out nll
     metric = "top1" if "top1" in ev["mean_model"] else "nll"
+    # 8-point trajectories: divergence SHAPE matters for the frontier
+    # study (growing vs plateaued consensus error are different verdicts)
+    stride = max(1, n_rounds // 8)
     return {
         "rounds": n_rounds,
         "metric": metric,
         "final_loss": round(float(np.mean(losses[-5:])), 4),
+        "loss_trajectory": [round(v, 3) for v in losses[::stride]],
+        "consensus_error_trajectory": [round(v, 3) for v in errs[::stride]],
         "consensus_error": round(errs[-1], 4),
         f"{metric}_consensus_model": round(
             float(ev["mean_model"][metric]), 4
@@ -319,13 +391,21 @@ def run_variant(cfg, wl, rounds: int) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--workload", choices=("mlp", "resnet", "lm", "lm_full"), default="mlp")
+    ap.add_argument("--workload", choices=("mlp", "resnet", "lm", "lm_full", "bert32"), default="mlp")
     ap.add_argument("--rounds", type=int, default=80)
     ap.add_argument("--noise", type=float, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--h-sweep", action="store_true")
     ap.add_argument("--gamma-sweep", action="store_true")
     ap.add_argument("--modes", default=None, help="comma substrings to keep")
+    ap.add_argument("--torus", action="store_true",
+                    help="add an 'exact torus' row (e.g. the 4x8 torus at "
+                         "world=32 next to the ring)")
+    ap.add_argument("--lr", type=float, default=None,
+                    help="override the workload's optimizer learning rate")
+    ap.add_argument("--gossip-steps", type=int, default=1,
+                    help="consensus iterations per round for the CHOCO rows "
+                         "(T small-gamma iterations; wire x T)")
     ap.add_argument("--codec-k", type=int, default=None,
                     help="override the workload codec's k (top-k per chunk) — "
                          "the lm_full frontier sweep's sparsity axis")
@@ -350,6 +430,11 @@ def main() -> None:
         if "codec" not in wl:
             raise SystemExit("--codec-k only applies to workloads with a pinned codec (lm_full)")
         wl["codec"] = dict(wl["codec"], k=args.codec_k)
+    if args.lr is not None:
+        # SAME optimizer family, new lr — replacing the family would make
+        # every row incomparable to the pinned recipe
+        factory = wl["opt_factory"]
+        wl["opt"] = lambda: factory(args.lr)
     rows = {}
     for name, cfg in variants(wl, args).items():
         rows[name] = run_variant(cfg, wl, args.rounds)

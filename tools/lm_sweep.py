@@ -31,7 +31,43 @@ if REPO not in sys.path:
 PEAK_BF16 = 197e12  # TPU v5e
 
 
-def run_variant(batch: int, remat: bool, steps: int) -> dict:
+def _cast_state_adamw(lr, dtype):
+    """AdamW whose mu/nu live in ``dtype`` (bf16 halves the optimizer
+    state's HBM traffic — the measured ~12 ms/step 4xf32 pass,
+    docs/perf.md). The update upcasts to f32, computes, downcasts; XLA
+    fuses the casts into the elementwise update so the only change is
+    wire format. bf16 keeps f32's exponent range, so nu (squared grads)
+    cannot overflow; the mantissa loss shows up (or doesn't) in the
+    sweep's loss column."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    inner = optax.adamw(lr)
+
+    def down(x):
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 and getattr(x, "ndim", 0) > 0:
+            return x.astype(dtype)
+        return x
+
+    def up(x):
+        if hasattr(x, "dtype") and x.dtype == dtype:
+            return x.astype(jnp.float32)
+        return x
+
+    def init(params):
+        return jax.tree.map(down, inner.init(params))
+
+    def update(grads, state, params=None):
+        updates, new_state = inner.update(
+            grads, jax.tree.map(up, state), params
+        )
+        return updates, jax.tree.map(down, new_state)
+
+    return optax.GradientTransformation(init, update)
+
+
+def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32") -> dict:
     import functools
 
     import jax
@@ -51,7 +87,11 @@ def run_variant(batch: int, remat: bool, steps: int) -> dict:
         )
     }
     loss_fn = gpt2_loss_fn(model)
-    tx = optax.adamw(2e-4)
+    tx = (
+        _cast_state_adamw(2e-4, jnp.bfloat16)
+        if opt == "bf16"
+        else optax.adamw(2e-4)
+    )
     params = model.init(jax.random.key(0), batch_data["input_ids"][:1])["params"]
     n_params = sum(x.size for x in jax.tree.leaves(params))
     carry0 = (params, tx.init(params), jax.random.key(1))
@@ -84,6 +124,7 @@ def run_variant(batch: int, remat: bool, steps: int) -> dict:
     out = {
         "batch": batch,
         "remat": remat,
+        "opt_state": opt,
         "tokens_sec": round(tokens_sec, 1),
         "step_ms": round(1000 * dt / steps, 2),
         "mfu": round(mfu, 4),
@@ -108,22 +149,27 @@ def main() -> None:
         help="auto: off for small batches, on past 8 (the HBM bound)",
     )
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--opts", default="f32",
+                    help="comma list of optimizer-state dtypes to sweep "
+                         "(f32, bf16) — bf16 mu/nu halves optimizer HBM "
+                         "traffic (VERDICT r3 item 9 lever)")
     args = ap.parse_args()
 
     variants = []
     for b in (int(x) for x in args.batches.split(",")):
-        if args.remat == "both":
-            variants += [(b, False), (b, True)]
-        elif args.remat == "auto":
-            variants.append((b, b > 8))
-        else:
-            variants.append((b, args.remat == "on"))
+        for opt in args.opts.split(","):
+            if args.remat == "both":
+                variants += [(b, False, opt), (b, True, opt)]
+            elif args.remat == "auto":
+                variants.append((b, b > 8, opt))
+            else:
+                variants.append((b, args.remat == "on", opt))
 
     rows = []
-    for batch, remat in variants:
+    for batch, remat, opt in variants:
         env = dict(os.environ)
         env["LM_SWEEP_ONE"] = json.dumps(
-            {"batch": batch, "remat": remat, "steps": args.steps}
+            {"batch": batch, "remat": remat, "steps": args.steps, "opt": opt}
         )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker"],
@@ -138,6 +184,7 @@ def main() -> None:
             got = {
                 "batch": batch,
                 "remat": remat,
+                "opt_state": opt,
                 "error": (proc.stderr or proc.stdout)[-400:],
             }
         rows.append(got)
@@ -150,7 +197,14 @@ if __name__ == "__main__":
         spec = json.loads(os.environ["LM_SWEEP_ONE"])
         print(
             "ONE_RESULT "
-            + json.dumps(run_variant(spec["batch"], spec["remat"], spec["steps"])),
+            + json.dumps(
+                run_variant(
+                    spec["batch"],
+                    spec["remat"],
+                    spec["steps"],
+                    spec.get("opt", "f32"),
+                )
+            ),
             flush=True,
         )
     else:

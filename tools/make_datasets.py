@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Synthesize FULL-SIZE, format-exact MNIST / CIFAR-10 dataset files.
+
+The sandbox has no network (SURVEY.md blocker box), so the real datasets
+named by BASELINE.json configs 1-2 cannot be downloaded. What CAN be
+closed locally is the format-and-scale half of the real-data story
+(VERDICT r3 item 5): files that are byte-layout-identical to the real
+distributions at the real sizes — MNIST idx ubyte (60,000 train /
+10,000 test) and CIFAR-10 binary batches (5 x 10,000 + test_batch) —
+with LEARNABLE class structure (per-class prototype + Gaussian pixel
+noise, quantized to uint8), so `train.py --data-dir` runs the full
+file-ingestion path end to end and the recorded accuracy means
+something. Swap in the genuine files and nothing else changes.
+
+Layouts (consensusml_tpu/data/files.py):
+- MNIST: ``train-images-idx3-ubyte`` etc. — 4-byte magic (0, 0, dtype
+  code 0x08, ndim), big-endian dim sizes, raw ubyte payload.
+- CIFAR-10: ``data_batch_{1..5}.bin`` / ``test_batch.bin`` — 10,000
+  records of 1 label byte + 3072 image bytes (3x32x32, channel-major).
+
+Usage:
+  python tools/make_datasets.py --out /tmp/datasets [--mnist-n 60000]
+      [--cifar-per-batch 10000] [--noise 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+
+def write_idx(path: str, arr: np.ndarray) -> None:
+    codes = {np.uint8: 0x08, np.int32: 0x0C}
+    code = codes[arr.dtype.type]
+    header = struct.pack(f">BBBB{arr.ndim}I", 0, 0, code, arr.ndim, *arr.shape)
+    with open(path, "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+def _prototypes(rng, classes: int, shape: tuple[int, ...]) -> np.ndarray:
+    """Smooth per-class prototype images in [64, 192] — distinct enough
+    that a small model separates them, noisy draws keep it non-trivial."""
+    protos = rng.normal(size=(classes, *shape))
+    # cheap smoothing: average over a sliding window along H and W so the
+    # class signal is low-frequency (like real image classes, and so
+    # uint8 quantization + noise doesn't erase it)
+    for axis in (1, 2):
+        protos = (
+            protos
+            + np.roll(protos, 1, axis=axis)
+            + np.roll(protos, -1, axis=axis)
+        ) / 3.0
+    protos -= protos.mean(axis=(1, 2, 3) if len(shape) == 3 else (1, 2), keepdims=True)
+    protos /= np.abs(protos).max() + 1e-9
+    return 128.0 + 64.0 * protos
+
+
+def _draw(rng, protos, labels, noise: float) -> np.ndarray:
+    x = protos[labels] + rng.normal(scale=noise, size=(len(labels), *protos.shape[1:]))
+    return np.clip(x, 0, 255).astype(np.uint8)
+
+
+def make_mnist(root: str, n_train: int, n_test: int, noise: float, seed: int = 0):
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    protos = _prototypes(rng, 10, (28, 28))
+    for prefix, n in (("train", n_train), ("t10k", n_test)):
+        labels = rng.integers(0, 10, size=n)
+        imgs = _draw(rng, protos, labels, noise)
+        write_idx(os.path.join(root, f"{prefix}-images-idx3-ubyte"), imgs)
+        write_idx(
+            os.path.join(root, f"{prefix}-labels-idx1-ubyte"),
+            labels.astype(np.uint8),
+        )
+    return root
+
+
+def make_cifar10(root: str, per_batch: int, noise: float, seed: int = 1):
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    protos = _prototypes(rng, 10, (32, 32, 3))
+    names = [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]
+    for name in names:
+        labels = rng.integers(0, 10, size=per_batch)
+        imgs = _draw(rng, protos, labels, noise)  # (N, 32, 32, 3)
+        # CIFAR binary layout is channel-major: R plane, G plane, B plane
+        flat = imgs.transpose(0, 3, 1, 2).reshape(per_batch, 3072)
+        rec = np.concatenate(
+            [labels.astype(np.uint8)[:, None], flat], axis=1
+        )
+        rec.tofile(os.path.join(root, name))
+    return root
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--mnist-n", type=int, default=60000)
+    p.add_argument("--mnist-test-n", type=int, default=10000)
+    p.add_argument("--cifar-per-batch", type=int, default=10000)
+    p.add_argument("--noise", type=float, default=40.0,
+                   help="pixel noise std (uint8 scale); 40 leaves the "
+                        "class signal learnable but not trivial")
+    args = p.parse_args()
+    mnist = make_mnist(
+        os.path.join(args.out, "mnist"), args.mnist_n, args.mnist_test_n,
+        args.noise,
+    )
+    cifar = make_cifar10(
+        os.path.join(args.out, "cifar-10-batches-bin"), args.cifar_per_batch,
+        args.noise,
+    )
+    for root in (mnist, cifar):
+        total = sum(
+            os.path.getsize(os.path.join(root, f)) for f in os.listdir(root)
+        )
+        print(f"{root}: {len(os.listdir(root))} files, {total / 1e6:.1f} MB")
+
+
+if __name__ == "__main__":
+    main()

@@ -387,6 +387,19 @@ def main(argv=None) -> int:
 
         from consensusml_tpu.train import SlowMoConfig
 
+        # measured hazard, not a style warning: on the hard CNN study the
+        # textbook beta 0.5 collapsed top-1 0.796 -> 0.121 because the
+        # outer momentum compounds the inner optimizer's (momentum-SGD /
+        # Adam) effective step (docs/convergence.md, VERDICT r3)
+        if args.slowmo_beta >= 0.4:
+            print(
+                f"warning: --slowmo-beta {args.slowmo_beta}: the "
+                "convergence study destabilized at beta 0.5 on a "
+                "momentum-SGD workload (top-1 0.796 -> 0.121, "
+                "docs/convergence.md); start at 0.2 and raise only while "
+                "held-out accuracy holds",
+                file=sys.stderr,
+            )
         try:
             bundle.cfg = dataclasses.replace(
                 bundle.cfg, outer=SlowMoConfig(beta=args.slowmo_beta)
