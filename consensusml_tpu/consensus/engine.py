@@ -116,10 +116,30 @@ class GossipConfig:
     # current innovation and ships a fresh payload, so wire bytes per
     # round multiply by T (wire_bytes_per_round accounts for it).
     gossip_steps: int = 1
+    # Exact-gossip warmup for compressed configs: rounds < N mix the
+    # params DENSELY while running the same innovation exchange to warm
+    # xhat/s, then round N switches to pure CHOCO with tracking state
+    # already caught up. Motivated by the r4 frontier trajectories
+    # (docs/convergence.md): under Adam the first ~50 rounds move params
+    # violently (embedding tables especially) and a sparse codec cannot
+    # track it — consensus error jumps ~7x in that window and never
+    # recovers, while the post-warmup innovations are small enough for
+    # top-k. The standard deep-gradient-compression recipe, adapted to
+    # CHOCO tracking. Wire during warmup = dense + innovation payload.
+    codec_warmup_rounds: int = 0
 
     def __post_init__(self):
         if self.gossip_steps < 1:
             raise ValueError(f"gossip_steps must be >= 1, got {self.gossip_steps}")
+        if self.codec_warmup_rounds < 0:
+            raise ValueError(
+                f"codec_warmup_rounds must be >= 0, got {self.codec_warmup_rounds}"
+            )
+        if self.codec_warmup_rounds > 0 and self.compressor is None:
+            raise NotImplementedError(
+                "codec_warmup_rounds without a compressor is meaningless: "
+                "exact mixing has no codec to warm up"
+            )
         if self.gossip_steps > 1 and self.push_sum:
             raise NotImplementedError(
                 "gossip_steps > 1 with push-sum is not supported: the mass "
@@ -348,8 +368,12 @@ class ConsensusEngine:
         count, so all branches agree across the mesh).
         """
         topo = self.topology
+        if self.config.codec_warmup_rounds > 0 and step is None:
+            raise ValueError(
+                "codec_warmup_rounds needs the round counter (step=...)"
+            )
         if not topo.is_time_varying:
-            return self._phase_collective(topo, params, state, alive, rng)
+            return self._phase_collective(topo, params, state, alive, rng, step)
         if step is None:
             raise ValueError(
                 f"{type(topo).__name__} is time-varying: round_collective "
@@ -360,7 +384,7 @@ class ConsensusEngine:
             for phase in topo.phases
         ]
         return jax.lax.switch(
-            step % topo.period, branches, params, state, alive, rng
+            step % topo.period, branches, params, state, alive, rng, step
         )
 
     def _phase_collective(
@@ -370,6 +394,7 @@ class ConsensusEngine:
         state: ChocoState | None,
         alive: jax.Array | None,
         rng: jax.Array | None,
+        step: jax.Array | None = None,
     ):
         if self.config.push_sum:
             if self.config.path_filter is not None:
@@ -424,15 +449,8 @@ class ConsensusEngine:
             # one compress/decompress over the concatenated tree instead
             # of ~3 kernel launches per leaf (see GossipConfig.fused_codec)
             x, unravel = _ravel_tree(x)
-        xhat, s = state.xhat, state.s
-        # T consensus iterations, each re-compressing the CURRENT
-        # innovation (CHOCO-Gossip run T times — see gossip_steps)
-        for it in range(n_iter):
-            it_rng = (
-                rng
-                if n_iter == 1
-                else (None if rng is None else jax.random.fold_in(rng, it))
-            )
+        def _track(x, xhat, s, it_rng):
+            """One innovation exchange: update xhat and s."""
             delta = jax.tree.map(jnp.subtract, x, xhat)
             q = comp.compress_tree(delta, it_rng)
             dec_q = comp.decompress_tree(q, like=delta)
@@ -452,11 +470,41 @@ class ConsensusEngine:
                     recv = comp.decompress_accumulate_tree(
                         q_nbr, recv, shift.weight
                     )
-            s = jax.tree.map(jnp.add, s, recv)
-            x = jax.tree.map(
-                lambda xi, si, hi: xi + self.config.gamma * (si - hi),
-                x, s, xhat,
-            )
+            return xhat, jax.tree.map(jnp.add, s, recv)
+
+        def _choco(x, xhat, s):
+            # T consensus iterations, each re-compressing the CURRENT
+            # innovation (CHOCO-Gossip run T times — see gossip_steps)
+            for it in range(n_iter):
+                it_rng = (
+                    rng
+                    if n_iter == 1
+                    else (None if rng is None else jax.random.fold_in(rng, it))
+                )
+                xhat, s = _track(x, xhat, s, it_rng)
+                x = jax.tree.map(
+                    lambda xi, si, hi: xi + self.config.gamma * (si - hi),
+                    x, s, xhat,
+                )
+            return x, xhat, s
+
+        def _warm(x, xhat, s):
+            # warmup round: the params ride EXACT mixing (n_iter times,
+            # matching what the exact engine with the same gossip_steps
+            # would do — and the exact-partition leaves above); the same
+            # innovation exchange still runs so xhat/s track x and the
+            # switch to compressed rounds starts caught up
+            xhat, s = _track(x, xhat, s, rng)
+            for _ in range(n_iter):
+                x = collectives.mix_tree(x, topo)
+            return x, xhat, s
+
+        xhat, s = state.xhat, state.s
+        warm = self.config.codec_warmup_rounds
+        if warm > 0:
+            x, xhat, s = jax.lax.cond(step < warm, _warm, _choco, x, xhat, s)
+        else:
+            x, xhat, s = _choco(x, xhat, s)
         x_new = x
         if unravel is not None:
             x_new = unravel(x_new)
@@ -534,14 +582,20 @@ class ConsensusEngine:
         w: jax.Array,
         alive: jax.Array | None = None,
         rng: jax.Array | None = None,
+        step: jax.Array | None = None,
     ):
         """One gossip round on stacked arrays (leading axis = workers).
 
         ``alive`` (``(world,)`` of 0/1, only with ``config.faults``): the
         per-worker participation flags for this round. ``rng``: stacked
         ``(world,)`` keys for stochastic codecs — the same per-worker draws
-        the collective backend makes.
+        the collective backend makes. ``step``: round counter (required
+        when ``codec_warmup_rounds > 0``).
         """
+        if self.config.codec_warmup_rounds > 0 and step is None:
+            raise ValueError(
+                "codec_warmup_rounds needs the round counter (step=...)"
+            )
         n_iter = self.config.gossip_steps
         if self.config.push_sum:
             if self.config.path_filter is not None:
@@ -580,23 +634,13 @@ class ConsensusEngine:
             # same flatten boundary as the collective backend: per-worker
             # rows (W, n), compress vmapped over the worker axis below
             x, unravel = _ravel_tree(x, stacked=True)
-        xhat, s = state.xhat, state.s
-        for it in range(n_iter):
+        def _track(x, xhat, s, it_rng):
             delta = jax.tree.map(jnp.subtract, x, xhat)
             # vmap the SAME compress_tree/decompress_tree path the
             # collective backend runs, so the per-leaf rng fold-in
             # convention has one source of truth and the backends draw
             # identical randomness (incl. the per-iteration fold)
             if comp.stochastic:
-                if rng is None:
-                    raise ValueError(
-                        f"{type(comp).__name__} is stochastic and needs stacked rng"
-                    )
-                it_rng = (
-                    rng
-                    if n_iter == 1
-                    else jax.vmap(lambda k: jax.random.fold_in(k, it))(rng)
-                )
                 dec_q = jax.vmap(
                     lambda t, k: comp.decompress_tree(comp.compress_tree(t, k), like=t)
                 )(delta, it_rng)
@@ -606,11 +650,39 @@ class ConsensusEngine:
                 )(delta)
             xhat = jax.tree.map(jnp.add, xhat, dec_q)
             recv = simulated.mix_tree_stacked(dec_q, w)
-            s = jax.tree.map(jnp.add, s, recv)
-            x = jax.tree.map(
-                lambda xi, si, hi: xi + self.config.gamma * (si - hi),
-                x, s, xhat,
+            return xhat, jax.tree.map(jnp.add, s, recv)
+
+        if comp.stochastic and rng is None:
+            raise ValueError(
+                f"{type(comp).__name__} is stochastic and needs stacked rng"
             )
+
+        def _choco(x, xhat, s):
+            for it in range(n_iter):
+                it_rng = (
+                    rng
+                    if (n_iter == 1 or rng is None)
+                    else jax.vmap(lambda k: jax.random.fold_in(k, it))(rng)
+                )
+                xhat, s = _track(x, xhat, s, it_rng)
+                x = jax.tree.map(
+                    lambda xi, si, hi: xi + self.config.gamma * (si - hi),
+                    x, s, xhat,
+                )
+            return x, xhat, s
+
+        def _warm(x, xhat, s):
+            xhat, s = _track(x, xhat, s, rng)
+            for _ in range(n_iter):  # match the exact engine at this T
+                x = simulated.mix_tree_stacked(x, w)
+            return x, xhat, s
+
+        xhat, s = state.xhat, state.s
+        warm = self.config.codec_warmup_rounds
+        if warm > 0:
+            x, xhat, s = jax.lax.cond(step < warm, _warm, _choco, x, xhat, s)
+        else:
+            x, xhat, s = _choco(x, xhat, s)
         x_new = x
         if unravel is not None:
             x_new = unravel(x_new)
@@ -623,13 +695,18 @@ class ConsensusEngine:
 
     # ---- accounting -----------------------------------------------------
     def wire_bytes_per_round(self, params: Any) -> int:
-        """Bytes ONE worker sends per gossip round (bandwidth accounting).
+        """Bytes ONE worker sends per STEADY-STATE gossip round.
 
         Exact mixing ships each gossiped leaf densely once per shift
         (dense topologies: one all-reduce pass counted as one send);
         compressed gossip ships the codec payload instead. Push-sum adds
         one f32 mass scalar per shift. Time-varying topologies report the
-        per-period average.
+        per-period average. ``gossip_steps`` multiplies the payload.
+        ``codec_warmup_rounds`` is NOT folded in: warmup rounds ship
+        dense params PLUS the innovation payload (a transient, not the
+        steady state this accounting describes) — callers totalling a
+        run's traffic should add ``warmup * (dense + payload)`` bytes
+        for the first ``codec_warmup_rounds`` rounds.
         """
         import numpy as np
 

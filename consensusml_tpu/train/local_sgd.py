@@ -562,7 +562,8 @@ def make_simulated_train_step(
             w_all[state.step[0] % topo.period] if topo.is_time_varying else w_all
         )
         mixed, gossip = engine.round_simulated(
-            _gossiped(params, model_state), state.gossip, w, alive, gsub
+            _gossiped(params, model_state), state.gossip, w, alive, gsub,
+            step=state.step[0],
         )
         params, model_state = mixed["params"], mixed["model_state"]
         outer = state.outer

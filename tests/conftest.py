@@ -111,6 +111,8 @@ _SLOW_TESTS = {
     "test_bench_emits_headline_json_when_budget_exhausted",
     "test_bench_wedged_preflight_skips_tpu_sections",
     "test_bench_sigterm_lands_partial_json",
+    "test_train_gossip_steps_and_gamma",
+    "test_train_gamma_rejected_on_exact_config",
 }
 
 

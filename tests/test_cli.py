@@ -253,3 +253,22 @@ def test_train_eval_every(tmp_path):
          "--rounds", "1", "--eval-every", "2"],
     )
     assert bad.returncode == 2 and "--eval-batches" in bad.stderr
+
+
+def test_train_gossip_steps_and_gamma():
+    r = _run(
+        [
+            "train.py", "--config", "gpt2_topk", "--device", "cpu",
+            "--backend", "simulated", "--rounds", "3",
+            "--gossip-steps", "2", "--gamma", "0.2",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final: loss=" in r.stdout
+
+
+def test_train_gamma_rejected_on_exact_config():
+    r = _run(["train.py", "--config", "mnist_mlp", "--device", "cpu",
+              "--gamma", "0.3", "--rounds", "2"])
+    assert r.returncode == 2
+    assert "--gamma" in r.stderr

@@ -498,3 +498,104 @@ def test_gossip_steps_stochastic_codec_backends_agree():
     np.testing.assert_allclose(
         np.asarray(col["a"]), np.asarray(sim["a"]), rtol=2e-5, atol=1e-6
     )
+
+
+def test_codec_warmup_rounds():
+    """Warmup rounds mix exactly (bit-equal to the exact engine) while
+    warming xhat/s; post-warmup rounds run pure CHOCO with tracking
+    already caught up — and the whole schedule stays cross-backend."""
+    topo = RingTopology(8)
+    comp = topk_int8_compressor(ratio=0.25, chunk=32)
+    warm_engine = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.3,
+                     codec_warmup_rounds=2)
+    )
+    exact_engine = ConsensusEngine(GossipConfig(topology=topo))
+
+    # warmup must track the exact engine at the SAME gossip_steps too
+    w2 = simulated.mixing_matrix(topo)
+    wg = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.3,
+                     codec_warmup_rounds=1, gossip_steps=2)
+    )
+    eg = ConsensusEngine(GossipConfig(topology=topo, gossip_steps=2))
+    p0 = _params(topo, seed=9)
+    stg = wg.init_state(p0, world_size=topo.world_size)
+    warm_out, _ = wg.round_simulated(p0, stg, w2, step=jnp.int32(0))
+    exact_out, _ = eg.round_simulated(p0, None, w2)
+    for key in p0:
+        np.testing.assert_allclose(
+            np.asarray(warm_out[key]), np.asarray(exact_out[key]), rtol=1e-6
+        )
+    stacked = _params(topo)
+    w = simulated.mixing_matrix(topo)
+
+    # rounds 0-1 (warmup): params move EXACTLY like exact mixing
+    st = warm_engine.init_state(stacked, world_size=topo.world_size)
+    cur = stacked
+    exact = stacked
+    for step in range(2):
+        cur, st = warm_engine.round_simulated(
+            cur, st, w, step=jnp.int32(step)
+        )
+        exact, _ = exact_engine.round_simulated(exact, None, w)
+        for key in stacked:
+            np.testing.assert_allclose(
+                np.asarray(cur[key]), np.asarray(exact[key]), rtol=1e-6
+            )
+    # tracking state warmed: xhat moved toward x (not still zero)
+    assert float(jnp.abs(st.xhat["w"]).sum()) > 0
+
+    # post-warmup: compressed rounds keep contracting disagreement
+    err = lambda t: float(
+        np.sqrt(np.mean(np.sum((np.asarray(t["w"]) - np.asarray(t["w"]).mean(0)) ** 2, axis=-1)))
+    )
+    e_before = err(cur)
+    for step in range(2, 6):
+        cur, st = warm_engine.round_simulated(cur, st, w, step=jnp.int32(step))
+    assert err(cur) < e_before
+
+    # cross-backend: the same schedule through the collective engine
+    got = _run_collective_steps(warm_engine, stacked, rounds=4)
+    st2 = warm_engine.init_state(stacked, world_size=topo.world_size)
+    sim = stacked
+    for step in range(4):
+        sim, st2 = warm_engine.round_simulated(sim, st2, w, step=jnp.int32(step))
+    for key in stacked:
+        np.testing.assert_allclose(
+            got[key], np.asarray(sim[key]), rtol=2e-5, atol=1e-6
+        )
+
+
+def _run_collective_steps(engine, stacked, rounds):
+    """Like _run_collective but passing the round counter (warmup)."""
+    import functools
+
+    topo = engine.topology
+    wmesh = WorkerMesh.create(topo, platform="cpu")
+    blocked = jax.tree.map(
+        lambda v: jax.device_put(
+            v.reshape(*topo.mesh_shape, *v.shape[1:]), wmesh.worker_sharding()
+        ),
+        stacked,
+    )
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=wmesh.mesh,
+        in_specs=P(*topo.axis_names),
+        out_specs=P(*topo.axis_names),
+    )
+    def run(tree):
+        state = engine.init_state(tree)
+        for step in range(rounds):
+            tree, state = engine.round_collective(
+                tree, state, step=jnp.int32(step)
+            )
+        return tree
+
+    out = run(blocked)
+    return jax.tree.map(
+        lambda v, ref: np.asarray(v).reshape(ref.shape), out, stacked
+    )

@@ -230,9 +230,11 @@ def variants(wl, args):
     # full-scale k=8/512); default = the smoke-scale ratio-0.1 codec
     ca = wl.get("codec", {"ratio": 0.1, "chunk": 128})
     gs = getattr(args, "gossip_steps", 1)
+    cw = getattr(args, "codec_warmup", 0)
     choco = lambda comp, gamma=0.5, hh=h: LocalSGDConfig(  # noqa: E731
         gossip=GossipConfig(
-            topology=ring, compressor=comp, gamma=gamma, gossip_steps=gs
+            topology=ring, compressor=comp, gamma=gamma, gossip_steps=gs,
+            codec_warmup_rounds=cw,
         ),
         optimizer=tx(),
         h=hh,
@@ -403,6 +405,9 @@ def main() -> None:
                          "world=32 next to the ring)")
     ap.add_argument("--lr", type=float, default=None,
                     help="override the workload's optimizer learning rate")
+    ap.add_argument("--codec-warmup", type=int, default=0,
+                    help="exact-gossip warmup rounds before the codec "
+                         "engages (CHOCO tracking warms during them)")
     ap.add_argument("--gossip-steps", type=int, default=1,
                     help="consensus iterations per round for the CHOCO rows "
                          "(T small-gamma iterations; wire x T)")

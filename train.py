@@ -63,6 +63,18 @@ def parse_args(argv=None):
                    help="swap the compressed-gossip codec on a compressed "
                         "config (topk_int4 = half the wire of the config-5 "
                         "default; same top-k, 4-bit value quantization)")
+    p.add_argument("--gossip-steps", type=int, default=None,
+                   help="consensus iterations per round (wire x N): N "
+                        "small-gamma CHOCO iterations contract like N "
+                        "rounds while each stays inside the stability "
+                        "region — the recalibration lever for aggressive "
+                        "codecs at scale (docs/convergence.md frontier)")
+    p.add_argument("--gamma", type=float, default=None,
+                   help="override the CHOCO consensus step size")
+    p.add_argument("--codec-warmup", type=int, default=None,
+                   help="exact-gossip warmup rounds before the compressed "
+                        "codec engages (innovation tracking warms during "
+                        "them; the frontier study's early-instability fix)")
     p.add_argument("--overlap-gossip", action="store_true",
                    help="combine-then-adapt gossip: the mixing correction is "
                         "computed from pre-inner-loop params and applied next "
@@ -371,6 +383,38 @@ def main(argv=None) -> int:
             bundle.cfg,
             gossip=dataclasses.replace(bundle.cfg.gossip, compressor=comp),
         )
+    if (
+        args.gossip_steps is not None
+        or args.gamma is not None
+        or args.codec_warmup is not None
+    ):
+        import dataclasses
+
+        overrides = {}
+        if args.gossip_steps is not None:
+            overrides["gossip_steps"] = args.gossip_steps
+        if args.codec_warmup is not None:
+            overrides["codec_warmup_rounds"] = args.codec_warmup
+        if args.gamma is not None:
+            if bundle.cfg.gossip.compressor is None:
+                print(
+                    "error: --gamma only applies to compressed-gossip "
+                    f"configs ({args.config} uses exact mixing)",
+                    file=sys.stderr,
+                )
+                return 2
+            overrides["gamma"] = args.gamma
+        try:
+            bundle.cfg = dataclasses.replace(
+                bundle.cfg,
+                gossip=dataclasses.replace(bundle.cfg.gossip, **overrides),
+            )
+        except (NotImplementedError, ValueError) as e:
+            print(
+                f"error: --gossip-steps/--gamma/--codec-warmup: {e}",
+                file=sys.stderr,
+            )
+            return 2
     if args.overlap_gossip:
         import dataclasses
 
