@@ -599,3 +599,40 @@ def _run_collective_steps(engine, stacked, rounds):
     return jax.tree.map(
         lambda v, ref: np.asarray(v).reshape(ref.shape), out, stacked
     )
+
+
+def test_codec_refresh_every():
+    """Every K-th round runs the dense warmup-style round: bit-equal to
+    exact mixing on refresh rounds, CHOCO between, cross-backend."""
+    topo = RingTopology(8)
+    comp = topk_int8_compressor(ratio=0.25, chunk=32)
+    eng = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=0.3,
+                     codec_refresh_every=3)
+    )
+    exact = ConsensusEngine(GossipConfig(topology=topo))
+    stacked = _params(topo, seed=11)
+    w = simulated.mixing_matrix(topo)
+
+    st = eng.init_state(stacked, world_size=topo.world_size)
+    cur = stacked
+    for step in range(6):
+        prev = cur
+        cur, st = eng.round_simulated(cur, st, w, step=jnp.int32(step))
+        if step % 3 == 0:  # refresh rounds mix exactly
+            ref, _ = exact.round_simulated(prev, None, w)
+            for key in stacked:
+                np.testing.assert_allclose(
+                    np.asarray(cur[key]), np.asarray(ref[key]), rtol=1e-6
+                )
+
+    # cross-backend over the mixed schedule
+    got = _run_collective_steps(eng, stacked, rounds=5)
+    st2 = eng.init_state(stacked, world_size=topo.world_size)
+    sim = stacked
+    for step in range(5):
+        sim, st2 = eng.round_simulated(sim, st2, w, step=jnp.int32(step))
+    for key in stacked:
+        np.testing.assert_allclose(
+            got[key], np.asarray(sim[key]), rtol=2e-5, atol=1e-6
+        )

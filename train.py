@@ -71,6 +71,10 @@ def parse_args(argv=None):
                         "codecs at scale (docs/convergence.md frontier)")
     p.add_argument("--gamma", type=float, default=None,
                    help="override the CHOCO consensus step size")
+    p.add_argument("--codec-refresh", type=int, default=None,
+                   help="dense refresh round every K rounds on a compressed "
+                        "config (bounds top-k error-feedback drift; "
+                        "amortized wire +dense/K)")
     p.add_argument("--codec-warmup", type=int, default=None,
                    help="exact-gossip warmup rounds before the compressed "
                         "codec engages (innovation tracking warms during "
@@ -387,6 +391,7 @@ def main(argv=None) -> int:
         args.gossip_steps is not None
         or args.gamma is not None
         or args.codec_warmup is not None
+        or args.codec_refresh is not None
     ):
         import dataclasses
 
@@ -395,6 +400,8 @@ def main(argv=None) -> int:
             overrides["gossip_steps"] = args.gossip_steps
         if args.codec_warmup is not None:
             overrides["codec_warmup_rounds"] = args.codec_warmup
+        if args.codec_refresh is not None:
+            overrides["codec_refresh_every"] = args.codec_refresh
         if args.gamma is not None:
             if bundle.cfg.gossip.compressor is None:
                 print(
