@@ -126,6 +126,15 @@ def consensus_error(
     current ``shard_map`` (e.g. ``("pp",)`` when each device holds its
     pipeline stage's layer slice) — the squared deviation is psum'd over
     them so the metric covers the whole model and stays replicated.
+
+    REQUIREMENT: when ``shard_axes`` is non-empty, EVERY leaf of ``tree``
+    must be sharded (disjointly partitioned) over those axes. A leaf
+    replicated over a shard axis — e.g. an embedding living outside the
+    per-stage ``stages/`` subtree — would have its squared deviation
+    psum'd axis-size times, inflating the metric. Callers with mixed
+    trees (the pipeline rules shard the whole param tree, so none exist
+    today) must split replicated leaves out and sum the two results
+    (replicated part with ``shard_axes=()``) rather than pass them here.
     """
     axes = topology.axis_names
     mean = jax.tree.map(lambda x: jax.lax.pmean(jnp.asarray(x, jnp.float32), axes), tree)

@@ -742,11 +742,13 @@ class ConsensusEngine:
         compressed gossip ships the codec payload instead. Push-sum adds
         one f32 mass scalar per shift. Time-varying topologies report the
         per-period average. ``gossip_steps`` multiplies the payload.
-        ``codec_warmup_rounds`` is NOT folded in: warmup rounds ship
-        dense params PLUS the innovation payload (a transient, not the
-        steady state this accounting describes) — callers totalling a
-        run's traffic should add ``warmup * (dense + payload)`` bytes
-        for the first ``codec_warmup_rounds`` rounds.
+        ``codec_warmup_rounds`` is NOT folded in: each warmup round runs
+        ``gossip_steps`` DENSE mixing passes (every consensus iteration
+        of a warm round ships the full params) plus ONE innovation
+        payload to keep xhat tracking in step — a transient, not the
+        steady state this accounting describes. Callers totalling a
+        run's traffic should add ``warmup * (gossip_steps * dense +
+        payload)`` bytes for the first ``codec_warmup_rounds`` rounds.
         """
         import numpy as np
 

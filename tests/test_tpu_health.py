@@ -26,6 +26,43 @@ FAKE_HANG = "import time; time.sleep(600)"
 FAKE_CRASH = "import sys; sys.stderr.write('boom'); sys.exit(3)"
 
 
+def _bench_module():
+    """Import bench.py (repo root, not a package) as a module."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_env(tmp_path, **extra):
+    """Env for bench.py subprocess tests: BENCH_DETAIL_PATH is redirected
+    so a suite run can never clobber the repo's real BENCH_DETAIL.json
+    round record."""
+    return {
+        **os.environ,
+        "BENCH_DETAIL_PATH": str(tmp_path / "detail.json"),
+        **extra,
+    }
+
+
+def _final_and_detail(stdout: str):
+    """Split bench.py stdout into (final compact record, full detail).
+
+    The driver parses the LAST line; section detail rides an earlier
+    ``BENCH_DETAIL`` line (see bench.py FINAL_LINE_LIMIT rationale)."""
+    limit = _bench_module().FINAL_LINE_LIMIT
+    final_line = [l for l in stdout.splitlines() if l.startswith("{")][-1]
+    assert len(final_line.encode()) <= limit, len(final_line.encode())
+    detail_line = [
+        l for l in stdout.splitlines() if l.startswith("BENCH_DETAIL ")
+    ][-1]
+    return json.loads(final_line), json.loads(detail_line[len("BENCH_DETAIL "):])
+
+
 def test_probe_alive_tpu(monkeypatch):
     monkeypatch.setenv("TPU_HEALTH_CMD", FAKE_TPU)
     r = probe(timeout=60)
@@ -104,7 +141,7 @@ def test_train_device_tpu_cpu_only_gives_clean_error():
     assert "no TPU reachable" in r.stderr
 
 
-def test_bench_emits_headline_json_when_budget_exhausted():
+def test_bench_emits_headline_json_when_budget_exhausted(tmp_path):
     """bench.py's one driver-parsed JSON line must land even when the
     global budget leaves no room for any section (VERDICT r3 item 1):
     every section is skipped, value is 0, and the note says why."""
@@ -114,22 +151,21 @@ def test_bench_emits_headline_json_when_budget_exhausted():
         capture_output=True,
         text=True,
         timeout=300,
-        env={
-            **os.environ,
-            "BENCH_DEVICE": "cpu",  # skips the TPU preflight
-            "BENCH_TOTAL_BUDGET": "10",  # below the per-section floor
-        },
+        env=_bench_env(
+            tmp_path,
+            BENCH_DEVICE="cpu",  # skips the TPU preflight
+            BENCH_TOTAL_BUDGET="10",  # below the per-section floor
+        ),
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
-    out = json.loads(line)
+    out, detail = _final_and_detail(r.stdout)
     assert out["unit"] == "imgs/sec/chip" and out["value"] == 0.0
     assert out["vs_baseline"] == 0.0
-    assert "budget exhausted" in json.dumps(out)
-    assert out["preflight"]["skipped"].startswith("BENCH_DEVICE")
+    assert "budget exhausted" in json.dumps(detail)
+    assert detail["preflight"]["skipped"].startswith("BENCH_DEVICE")
 
 
-def test_bench_wedged_preflight_skips_tpu_sections():
+def test_bench_wedged_preflight_skips_tpu_sections(tmp_path):
     """With a wedged tunnel the preflight fails fast and bench.py still
     emits the headline line: TPU sections are skipped with an honest
     note, CPU sections are attempted (and here budget-skipped)."""
@@ -139,23 +175,22 @@ def test_bench_wedged_preflight_skips_tpu_sections():
         capture_output=True,
         text=True,
         timeout=300,
-        env={
-            **os.environ,
-            "TPU_HEALTH_CMD": FAKE_HANG,
-            "BENCH_PREFLIGHT_TIMEOUT": "2",
-            "BENCH_TOTAL_BUDGET": "40",  # preflight fits, sections don't
-        },
+        env=_bench_env(
+            tmp_path,
+            TPU_HEALTH_CMD=FAKE_HANG,
+            BENCH_PREFLIGHT_TIMEOUT="2",
+            BENCH_TOTAL_BUDGET="40",  # preflight fits, sections don't
+        ),
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
-    out = json.loads(line)
+    out, detail = _final_and_detail(r.stdout)
     assert out["value"] == 0.0
-    assert "preflight" in out and out["preflight"]["alive"] is False
+    assert "preflight" in detail and detail["preflight"]["alive"] is False
     assert "TPU sections skipped" in out["note"]
-    assert "fed_input" not in out  # never scheduled without a tunnel
+    assert "fed_input" not in detail  # never scheduled without a tunnel
 
 
-def test_bench_sigterm_lands_partial_json():
+def test_bench_sigterm_lands_partial_json(tmp_path):
     """The driver's timeout delivers SIGTERM before SIGKILL; bench.py
     must use that window to print the partial headline line (round 3's
     rc=124/empty-tail failure mode)."""
@@ -168,17 +203,48 @@ def test_bench_sigterm_lands_partial_json():
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
-        env={
-            **os.environ,
-            "BENCH_DEVICE": "cpu",
-            "BENCH_TOTAL_BUDGET": "3000",  # roomy: sections would run
-        },
+        env=_bench_env(
+            tmp_path,
+            BENCH_DEVICE="cpu",
+            BENCH_TOTAL_BUDGET="3000",  # roomy: sections would run
+        ),
     )
     time.sleep(5)  # inside the first (slow) section's child
     proc.send_signal(signal.SIGTERM)
     out, err = proc.communicate(timeout=60)
     assert proc.returncode == 0, err[-2000:]
-    line = [l for l in out.splitlines() if l.startswith("{")][-1]
-    parsed = json.loads(line)
+    parsed, _ = _final_and_detail(out)
     assert parsed["unit"] == "imgs/sec/chip"
     assert "signal 15" in parsed["note"]
+
+
+def test_bench_final_line_capped_worst_case():
+    """The driver's tail window is ~2000 bytes; round 4's record died when
+    the one JSON line outgrew it. build_final_line must cap the line at
+    800 bytes for ANY note — including one bigger than the window itself —
+    while never dropping the numeric fields."""
+    bench = _bench_module()
+
+    worst_note = (
+        'a "quoted" note with escapes \\ and unicode é ' * 200
+    )  # ~9 KB pre-escaping, expands further when JSON-escaped
+    payload = {
+        "metric": "imgs/sec/chip (ResNet-50 consensus-SGD, bf16 224px)",
+        "value": 2536.13,
+        "unit": "imgs/sec/chip",
+        "vs_baseline": 1.0144,
+        "elapsed_s": 2512.7,
+        "note": worst_note,
+    }
+    line = bench.build_final_line(payload)
+    assert len(line.encode("utf-8")) <= bench.FINAL_LINE_LIMIT, len(line.encode("utf-8"))
+    out = json.loads(line)
+    assert out["value"] == 2536.13 and out["vs_baseline"] == 1.0144
+    assert out["unit"] == "imgs/sec/chip" and out["elapsed_s"] == 2512.7
+    assert out["note"].endswith("...") and len(out["note"]) > 0
+
+    # empty and short notes pass through untouched
+    for note in ("", "short note"):
+        line = bench.build_final_line({**payload, "note": note})
+        assert json.loads(line)["note"] == note
+        assert len(line.encode()) <= bench.FINAL_LINE_LIMIT
