@@ -34,6 +34,29 @@ __all__ = [
 ]
 
 
+def _ring_yield(loader, rounds, world_size, h, batch, image_shape):
+    """Shared consume loop. The loader's wire mode decides the yielded
+    image dtype (u8 wire → uint8 arrays; the training step dequants ON
+    DEVICE with ``u8 / loader.qscale - loader.qoff``).
+
+    Each round gets FRESH host arrays (loader.next() copies out of the
+    ring): ``jnp.asarray`` ALIASES numpy memory on the CPU backend and
+    may read it asynchronously on TPU, so a reused host buffer would
+    silently rewrite batches the consumer still holds. Callers that can
+    prove their batch lifetimes may manage rotation themselves via
+    ``loader.next(out=...)``."""
+    import jax.numpy as jnp
+
+    for _ in range(rounds):
+        data, ints = loader.next()
+        yield {
+            "image": jnp.asarray(
+                data.reshape(world_size, h, batch, *image_shape)
+            ),
+            "label": jnp.asarray(ints.reshape(world_size, h, batch)),
+        }
+
+
 def native_round_batches(
     dataset: SyntheticClassification,
     world_size: int,
@@ -44,15 +67,19 @@ def native_round_batches(
     depth: int = 4,
     nthreads: int = 2,
     start: int = 0,
+    wire: str = "f32",
+    qscale: float = 32.0,
+    qoff: float = 4.0,
 ):
     """Yield ``rounds`` stacked ``(W, H, B, *image_shape)`` batches.
 
     Deterministic in ``seed`` (independent of depth/nthreads/timing).
     ``start`` fast-forwards the stream by consuming that many slots — the
     slot sequence is the round number, so resume keeps the exact stream.
+    ``wire="u8"`` ships quantized bytes (1/4 the host->device traffic;
+    producer threads run the quantize pass) — consumers dequant on device
+    as ``u8 / qscale - qoff``.
     """
-    import jax.numpy as jnp
-
     from consensusml_tpu.native import NativeLoader
 
     sample_floats = int(np.prod(dataset.image_shape))
@@ -69,15 +96,13 @@ def native_round_batches(
         nthreads=nthreads,
         seed=seed,
         start_seq=start,
+        wire=wire,
+        qscale=qscale,
+        qoff=qoff,
     ) as loader:
-        for _ in range(rounds):
-            floats, ints = loader.next()
-            yield {
-                "image": jnp.asarray(
-                    floats.reshape(world_size, h, batch, *dataset.image_shape)
-                ),
-                "label": jnp.asarray(ints.reshape(world_size, h, batch)),
-            }
+        yield from _ring_yield(
+            loader, rounds, world_size, h, batch, dataset.image_shape
+        )
 
 
 def native_lm_round_batches(
@@ -135,6 +160,9 @@ def native_file_round_batches(
     depth: int = 4,
     nthreads: int = 2,
     start: int = 0,
+    wire: str = "f32",
+    qscale: float = 32.0,
+    qoff: float = 4.0,
 ):
     """File-backed classification batches through the C++ prefetch ring.
 
@@ -145,8 +173,6 @@ def native_file_round_batches(
     path's numpy draws (documented divergence, as with the procedural
     kinds).
     """
-    import jax.numpy as jnp
-
     from consensusml_tpu.native import NativeLoader
 
     sample_floats = int(np.prod(dataset.image_shape))
@@ -163,15 +189,13 @@ def native_file_round_batches(
         nthreads=nthreads,
         seed=seed,
         start_seq=start,
+        wire=wire,
+        qscale=qscale,
+        qoff=qoff,
     ) as loader:
-        for _ in range(rounds):
-            floats, ints = loader.next()
-            yield {
-                "image": jnp.asarray(
-                    floats.reshape(world_size, h, batch, *dataset.image_shape)
-                ),
-                "label": jnp.asarray(ints.reshape(world_size, h, batch)),
-            }
+        yield from _ring_yield(
+            loader, rounds, world_size, h, batch, dataset.image_shape
+        )
 
 
 def native_file_token_batches(

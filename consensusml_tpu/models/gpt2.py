@@ -35,6 +35,10 @@ class GPT2Config:
     # drop from O(layers) to O(1) blocks at ~1/3 extra fwd FLOPs — the
     # standard lever when batch scaling is HBM-bound, off by default
     remat: bool = False
+    # "flax" (default) | "pallas" | "auto" | "interpret": the fused-LN
+    # Pallas kernel (models/fused_ln.py). Parity-pinned; measured
+    # keep/reject verdict in docs/perf.md — flax stays the default.
+    norm_impl: str = "flax"
 
     @property
     def mlp_dim(self) -> int:
@@ -45,6 +49,19 @@ def gpt2_medium(**overrides) -> "GPT2LM":
     return GPT2LM(config=GPT2Config(**overrides))
 
 
+def _layer_norm(config: "GPT2Config", name: str):
+    """LN factory: flax by default; the fused Pallas kernel emits bf16
+    straight into the consuming bf16 matmul when opted in (identical
+    numerics to f32-out-then-cast — see models/fused_ln.py)."""
+    if config.norm_impl == "flax":
+        return nn.LayerNorm(dtype=jnp.float32, name=name)
+    from consensusml_tpu.models.fused_ln import FusedLayerNorm
+
+    return FusedLayerNorm(
+        out_dtype=config.dtype, impl=config.norm_impl, name=name
+    )
+
+
 class _DecoderBlock(nn.Module):
     config: GPT2Config
 
@@ -52,13 +69,13 @@ class _DecoderBlock(nn.Module):
     def __call__(self, x, deterministic: bool):
         c = self.config
         d_head = c.hidden // c.heads
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        y = _layer_norm(c, "ln_1")(x)
         qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
         attn = nn.DenseGeneral(c.hidden, axis=(-2, -1), dtype=c.dtype, name="out")(attn)
         x = x + nn.Dropout(c.dropout, deterministic=deterministic)(attn)
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        y = _layer_norm(c, "ln_2")(x)
         y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(c.hidden, dtype=c.dtype, name="mlp_out")(y)
@@ -85,7 +102,7 @@ class GPT2LM(nn.Module):
         )
         for i in range(c.layers):
             x = block(c, name=f"h_{i}")(x, deterministic)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = _layer_norm(c, "ln_f")(x)
         logits = tok_emb.attend(jnp.asarray(x, tok_emb.dtype))
         return jnp.asarray(logits, jnp.float32)
 

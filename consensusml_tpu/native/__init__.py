@@ -43,6 +43,7 @@ _load_failed: str | None = None
 _f32p = ctypes.POINTER(ctypes.c_float)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i8p = ctypes.POINTER(ctypes.c_int8)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 def _build() -> None:
@@ -98,19 +99,26 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_float, _f32p, _i32p, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_float, ctypes.c_float,
     ]
     lib.cml_loader_create.restype = ctypes.c_void_p
     lib.cml_loader_create_file.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_float, ctypes.c_float,
     ]
     lib.cml_loader_create_file.restype = ctypes.c_void_p
     lib.cml_loader_acquire.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(_f32p), ctypes.POINTER(_i32p),
     ]
     lib.cml_loader_acquire.restype = ctypes.c_int
+    lib.cml_loader_acquire_u8.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_u8p), ctypes.POINTER(_i32p),
+    ]
+    lib.cml_loader_acquire_u8.restype = ctypes.c_int
+    lib.cml_loader_float_bytes.argtypes = [ctypes.c_void_p]
+    lib.cml_loader_float_bytes.restype = ctypes.c_int32
     lib.cml_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.cml_loader_produced.argtypes = [ctypes.c_void_p]
     lib.cml_loader_produced.restype = ctypes.c_uint64
@@ -221,13 +229,24 @@ class NativeLoader:
         nthreads: int = 2,
         seed: int = 0,
         start_seq: int = 0,
+        # "f32" (default) or "u8": u8 ships quantized bytes — producer
+        # threads run clip((x + qoff) * qscale) and the consumer dequants
+        # ON DEVICE (x^ = u8/qscale - qoff) — quartering host->device wire
+        wire: str = "f32",
+        qscale: float = 32.0,
+        qoff: float = 4.0,
     ):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_load_failed}")
+        if wire not in ("f32", "u8"):
+            raise ValueError(f"unknown wire {wire!r}")
         self._lib = lib
+        self._wire = wire
+        self.qscale, self.qoff = float(qscale), float(qoff)
         self._shape_f = (samples_per_slot, sample_floats)
         self._shape_i = (samples_per_slot, sample_ints)
+        fb = 1 if wire == "u8" else 4
         kinds = {"classification": 0, "lm": 1, "file_classification": 2, "file_lm": 3}
         if kind not in kinds:
             raise ValueError(f"unknown kind {kind!r}")
@@ -260,6 +279,7 @@ class NativeLoader:
                 depth, nthreads, seed, kinds[kind],
                 samples_per_slot, sample_floats, sample_ints, world,
                 data_p, label_p, tok_p, n_items, token_bytes, start_seq,
+                fb, self.qscale, self.qoff,
             )
             if not self._h:
                 raise RuntimeError(
@@ -267,6 +287,7 @@ class NativeLoader:
                     "samples_per_slot, and the table is large enough for "
                     f"{world} workers: n_items={n_items})"
                 )
+            self._check_wire(fb)
             return
         proto_p = None
         succ_p = None
@@ -284,28 +305,57 @@ class NativeLoader:
             depth, nthreads, seed, kinds[kind],
             samples_per_slot, sample_floats, sample_ints,
             nclasses_or_vocab, noise, proto_p, succ_p, start_seq,
+            fb, self.qscale, self.qoff,
         )
         if not self._h:
             raise RuntimeError("cml_loader_create failed (bad arguments)")
+        self._check_wire(fb)
 
-    def next(self) -> tuple[np.ndarray, np.ndarray]:
-        """Blocking: copies of the next slot's (floats, ints) arrays."""
-        fptr = _f32p()
+    def _check_wire(self, fb: int) -> None:
+        """Attach-time invariant: the library's wire mode for this handle
+        matches what this wrapper will read (guards a stale .so whose
+        create ignored the float_bytes argument)."""
+        got = int(self._lib.cml_loader_float_bytes(self._h))
+        if got != fb:
+            raise RuntimeError(
+                f"native loader wire mismatch: library reports "
+                f"float_bytes={got}, wrapper expected {fb} — rebuild "
+                "native/ (make -C native)"
+            )
+
+    def next(self, out=None) -> tuple[np.ndarray, np.ndarray]:
+        """Blocking: the next slot's (floats-or-u8, ints) arrays.
+
+        ``out``: optional (data, ints) numpy pair to copy INTO (rotating
+        reusable buffers let the backend's transfer path reuse staging
+        state instead of seeing a fresh allocation every round)."""
+        data_p = _u8p() if self._wire == "u8" else _f32p()
         iptr = _i32p()
-        idx = self._lib.cml_loader_acquire(self._h, ctypes.byref(fptr), ctypes.byref(iptr))
+        acquire = (
+            self._lib.cml_loader_acquire_u8
+            if self._wire == "u8"
+            else self._lib.cml_loader_acquire
+        )
+        idx = acquire(self._h, ctypes.byref(data_p), ctypes.byref(iptr))
         if idx < 0:
             raise RuntimeError("loader stopped")
-        def _copy(ptr, shape, dtype):
+        dtype = np.uint8 if self._wire == "u8" else np.float32
+
+        def _copy(ptr, shape, dt, dst):
             if 0 in shape:  # empty buffer: C++ data() may be NULL
-                return np.empty(shape, dtype)
-            return np.ctypeslib.as_array(ptr, shape=shape).copy()
+                return np.empty(shape, dt)
+            src = np.ctypeslib.as_array(ptr, shape=shape)
+            if dst is not None:
+                np.copyto(dst, src)
+                return dst
+            return src.copy()
 
         try:
-            floats = _copy(fptr, self._shape_f, np.float32)
-            ints = _copy(iptr, self._shape_i, np.int32)
+            data = _copy(data_p, self._shape_f, dtype, out and out[0])
+            ints = _copy(iptr, self._shape_i, np.int32, out and out[1])
         finally:
             self._lib.cml_loader_release(self._h, idx)
-        return floats, ints
+        return data, ints
 
     def produced(self) -> int:
         return int(self._lib.cml_loader_produced(self._h))

@@ -50,6 +50,7 @@ enum class SlotState : int { kFree = 0, kFilling = 1, kReady = 2, kInUse = 3 };
 
 struct Slot {
   std::vector<float> floats;
+  std::vector<uint8_t> u8;  // used instead of floats when float_bytes == 1
   std::vector<int32_t> ints;
   SlotState state = SlotState::kFree;
   uint64_t seq = 0;  // valid when kReady/kInUse
@@ -63,7 +64,8 @@ class Loader {
          const int32_t* successors, int32_t world = 1,
          const float* file_data = nullptr, const int32_t* file_labels = nullptr,
          const int32_t* file_tokens = nullptr, int64_t n_items = 0,
-         int32_t token_bytes = 4, uint64_t start_seq = 0)
+         int32_t token_bytes = 4, uint64_t start_seq = 0,
+         int32_t float_bytes = 4, float qscale = 1.0f, float qoff = 0.0f)
       : depth_(depth),
         seed_(seed),
         kind_(kind),
@@ -77,7 +79,10 @@ class Loader {
         file_labels_(file_labels),
         file_tokens_(file_tokens),
         n_items_(n_items),
-        token_bytes_(token_bytes) {
+        token_bytes_(token_bytes),
+        float_bytes_(float_bytes),
+        qscale_(qscale),
+        qoff_(qoff) {
     // resume: slot contents are f(seed, seq), so starting both counters at
     // start_seq reproduces the stream from that round in O(1)
     next_produce_ = start_seq;
@@ -91,7 +96,11 @@ class Loader {
     }
     slots_.resize(depth_);
     for (auto& s : slots_) {
-      s.floats.resize(samples_per_slot_ * sample_floats_);
+      if (float_bytes_ == 1) {
+        s.u8.resize(samples_per_slot_ * sample_floats_);
+      } else {
+        s.floats.resize(samples_per_slot_ * sample_floats_);
+      }
       s.ints.resize(samples_per_slot_ * sample_ints_);
     }
     for (int t = 0; t < nthreads; ++t) {
@@ -115,17 +124,13 @@ class Loader {
   // wait on distinct slots instead of racing for (and possibly deadlocking
   // on) the same one.
   int Acquire(float** fptr, int32_t** iptr) {
-    std::unique_lock<std::mutex> lk(mu_);
-    const uint64_t want = next_consume_++;
-    Slot& slot = slots_[want % depth_];
-    cv_consumer_.wait(lk, [&] {
-      return stop_ || (slot.state == SlotState::kReady && slot.seq == want);
-    });
-    if (stop_) return -1;
-    slot.state = SlotState::kInUse;
-    *fptr = slot.floats.data();
-    *iptr = slot.ints.data();
-    return (int)(want % depth_);
+    uint8_t* unused = nullptr;
+    return AcquireImpl(fptr, &unused, iptr);
+  }
+
+  int AcquireU8(uint8_t** bptr, int32_t** iptr) {
+    float* unused = nullptr;
+    return AcquireImpl(&unused, bptr, iptr);
   }
 
   void Release(int idx) {
@@ -141,7 +146,24 @@ class Loader {
     return next_produce_;
   }
 
+  int32_t FloatBytes() const { return float_bytes_; }
+
  private:
+  int AcquireImpl(float** fptr, uint8_t** bptr, int32_t** iptr) {
+    std::unique_lock<std::mutex> lk(mu_);
+    const uint64_t want = next_consume_++;
+    Slot& slot = slots_[want % depth_];
+    cv_consumer_.wait(lk, [&] {
+      return stop_ || (slot.state == SlotState::kReady && slot.seq == want);
+    });
+    if (stop_) return -1;
+    slot.state = SlotState::kInUse;
+    *fptr = slot.floats.data();
+    *bptr = slot.u8.data();
+    *iptr = slot.ints.data();
+    return (int)(want % depth_);
+  }
+
   void ProducerLoop() {
     for (;;) {
       uint64_t seq;
@@ -181,9 +203,18 @@ class Loader {
           const int64_t shard = n_items_ / world_;
           const int64_t idx =
               w * shard + (int64_t)rng.randint64((uint64_t)shard);
-          std::memcpy(slot.floats.data() + i * sample_floats_,
-                      file_data_ + idx * sample_floats_,
-                      sizeof(float) * sample_floats_);
+          const float* src = file_data_ + idx * sample_floats_;
+          if (float_bytes_ == 1) {
+            // u8 wire: producer threads do the quantize pass so the
+            // consumer ships 1/4 the bytes and dequants on device
+            uint8_t* dst = slot.u8.data() + i * sample_floats_;
+            for (int64_t j = 0; j < sample_floats_; ++j) {
+              dst[j] = QuantU8(src[j]);
+            }
+          } else {
+            std::memcpy(slot.floats.data() + i * sample_floats_, src,
+                        sizeof(float) * sample_floats_);
+          }
           for (int64_t j = 0; j < sample_ints_; ++j) {
             slot.ints[i * sample_ints_ + j] = file_labels_[idx];
           }
@@ -208,12 +239,21 @@ class Loader {
       }
       if (kind_ == 0) {
         const int32_t label = (int32_t)rng.randint((uint32_t)nclasses_);
-        float* img = slot.floats.data() + i * sample_floats_;
         const float* proto =
             prototypes_.empty() ? nullptr
                                 : prototypes_.data() + (int64_t)label * sample_floats_;
-        for (int64_t j = 0; j < sample_floats_; ++j) {
-          img[j] = (proto != nullptr ? proto[j] : 0.0f) + noise_ * rng.gauss();
+        if (float_bytes_ == 1) {
+          uint8_t* img = slot.u8.data() + i * sample_floats_;
+          for (int64_t j = 0; j < sample_floats_; ++j) {
+            const float v =
+                (proto != nullptr ? proto[j] : 0.0f) + noise_ * rng.gauss();
+            img[j] = QuantU8(v);
+          }
+        } else {
+          float* img = slot.floats.data() + i * sample_floats_;
+          for (int64_t j = 0; j < sample_floats_; ++j) {
+            img[j] = (proto != nullptr ? proto[j] : 0.0f) + noise_ * rng.gauss();
+          }
         }
         for (int64_t j = 0; j < sample_ints_; ++j) {
           slot.ints[i * sample_ints_ + j] = label;
@@ -243,6 +283,16 @@ class Loader {
   const int32_t* file_tokens_;  // borrowed (kind 3; raw uint16 when token_bytes_==2)
   const int64_t n_items_;
   const int32_t token_bytes_;  // 2 (uint16 memmap passthrough) or 4 (int32)
+  const int32_t float_bytes_;  // 4 (f32 wire) or 1 (u8 wire)
+  const float qscale_;  // u8 = clip((x + qoff) * qscale); x^ = u8/qscale - qoff
+  const float qoff_;
+
+  uint8_t QuantU8(float v) const {
+    float q = (v + qoff_) * qscale_;
+    if (q < 0.0f) q = 0.0f;
+    if (q > 255.0f) q = 255.0f;
+    return (uint8_t)(q + 0.5f);
+  }
   std::vector<float> prototypes_;
   std::vector<int32_t> successors_;
 
@@ -264,15 +314,18 @@ void* cml_loader_create(int depth, int nthreads, uint64_t seed, int kind,
                         int64_t samples_per_slot, int64_t sample_floats,
                         int64_t sample_ints, int32_t nclasses_or_vocab,
                         float noise, const float* prototypes,
-                        const int32_t* successors, uint64_t start_seq) {
+                        const int32_t* successors, uint64_t start_seq,
+                        int32_t float_bytes, float qscale, float qoff) {
   if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
   if (kind != 0 && kind != 1) return nullptr;
   if (kind == 1 && (successors == nullptr || nclasses_or_vocab < 2)) return nullptr;
   if (nclasses_or_vocab < 1) return nullptr;
+  if (float_bytes != 4 && float_bytes != 1) return nullptr;
+  if (float_bytes == 1 && qscale <= 0.0f) return nullptr;
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, nclasses_or_vocab, noise,
                          prototypes, successors, /*world=*/1, nullptr, nullptr,
-                         nullptr, 0, 4, start_seq);
+                         nullptr, 0, 4, start_seq, float_bytes, qscale, qoff);
 }
 
 // File-backed kinds (2 = classification table, 3 = token windows). The
@@ -282,11 +335,14 @@ void* cml_loader_create_file(int depth, int nthreads, uint64_t seed, int kind,
                              int64_t sample_ints, int32_t world,
                              const float* data, const int32_t* labels,
                              const int32_t* tokens, int64_t n_items,
-                             int32_t token_bytes, uint64_t start_seq) {
+                             int32_t token_bytes, uint64_t start_seq,
+                             int32_t float_bytes, float qscale, float qoff) {
   if (depth < 1 || nthreads < 1 || samples_per_slot < 1) return nullptr;
   if (world < 1 || samples_per_slot % world != 0) return nullptr;
   if (n_items < world) return nullptr;
   if (token_bytes != 2 && token_bytes != 4) return nullptr;
+  if (float_bytes != 4 && float_bytes != 1) return nullptr;
+  if (float_bytes == 1 && (kind != 2 || qscale <= 0.0f)) return nullptr;
   if (kind == 2) {
     if (data == nullptr || labels == nullptr || sample_floats < 1) return nullptr;
     if (n_items / world < 1) return nullptr;
@@ -299,11 +355,20 @@ void* cml_loader_create_file(int depth, int nthreads, uint64_t seed, int kind,
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, /*nclasses=*/1,
                          /*noise=*/0.0f, nullptr, nullptr, world, data, labels,
-                         tokens, n_items, token_bytes, start_seq);
+                         tokens, n_items, token_bytes, start_seq, float_bytes,
+                         qscale, qoff);
 }
 
 int cml_loader_acquire(void* h, float** fptr, int32_t** iptr) {
   return static_cast<cml::Loader*>(h)->Acquire(fptr, iptr);
+}
+
+int cml_loader_acquire_u8(void* h, uint8_t** bptr, int32_t** iptr) {
+  return static_cast<cml::Loader*>(h)->AcquireU8(bptr, iptr);
+}
+
+int32_t cml_loader_float_bytes(void* h) {
+  return static_cast<cml::Loader*>(h)->FloatBytes();
 }
 
 void cml_loader_release(void* h, int idx) {

@@ -331,3 +331,70 @@ def test_native_start_seq_resumes_stream_exactly():
     for a, b in zip(full[3:], tail):
         np.testing.assert_array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
         np.testing.assert_array_equal(np.asarray(a["label"]), np.asarray(b["label"]))
+
+
+def test_loader_u8_wire_quantizes_f32_stream():
+    """u8 wire = clip((x + qoff) * qscale) of the SAME deterministic f32
+    stream (labels identical, values within half a quant step), shipped
+    as uint8 — the 1/4-wire mode the fed bench measures."""
+    proto = np.arange(10 * 16, dtype=np.float32).reshape(10, 16) / 100.0
+    kw = dict(
+        kind="classification", samples_per_slot=8, sample_floats=16,
+        sample_ints=1, nclasses_or_vocab=10, noise=0.1, prototypes=proto,
+        seed=11,
+    )
+    with native.NativeLoader(**kw) as a, native.NativeLoader(
+        **kw, wire="u8", qscale=32.0, qoff=4.0
+    ) as b:
+        f, fi = a.next()
+        u, ui = b.next()
+    assert u.dtype == np.uint8
+    np.testing.assert_array_equal(fi, ui)
+    want = np.clip((f + 4.0) * 32.0, 0, 255)
+    np.testing.assert_allclose(u.astype(np.float32), want, atol=0.5)
+    # device-side dequant recovers the f32 values to half a quant step
+    np.testing.assert_allclose(
+        u.astype(np.float32) / 32.0 - 4.0, f, atol=0.5 / 32.0 + 1e-6
+    )
+
+
+def test_loader_u8_wire_file_kind():
+    from consensusml_tpu.data.native_pipeline import native_file_round_batches
+
+    class _DS:
+        n = 8
+        image_shape = (4, 4, 1)
+        images = (np.arange(8 * 16, dtype=np.float32).reshape(8, 16) % 7) / 7.0
+        labels = np.arange(8, dtype=np.int32)
+
+    f32 = list(native_file_round_batches(_DS(), 2, 1, 2, rounds=3, seed=5))
+    u8 = list(
+        native_file_round_batches(
+            _DS(), 2, 1, 2, rounds=3, seed=5, wire="u8", qscale=255.0, qoff=0.0
+        )
+    )
+    for a, b in zip(f32, u8):
+        assert np.asarray(b["image"]).dtype == np.uint8
+        np.testing.assert_array_equal(
+            np.asarray(a["label"]), np.asarray(b["label"])
+        )
+        # the table values are k/7 with k<7, so /255 quantization is
+        # lossless to half a step
+        np.testing.assert_allclose(
+            np.asarray(b["image"]).astype(np.float32) / 255.0,
+            np.asarray(a["image"]),
+            atol=0.5 / 255.0 + 1e-6,
+        )
+
+
+def test_loader_next_out_reuse_matches_fresh_copies():
+    """next(out=...) fills caller buffers with the identical stream (the
+    rotating-buffer fast path the pipeline iterators use)."""
+    with _mk_loader(seed=9) as a, _mk_loader(seed=9) as b:
+        outs = (np.empty((8, 16), np.float32), np.empty((8, 1), np.int32))
+        for _ in range(4):
+            ff, fi = a.next()
+            rf, ri = b.next(out=outs)
+            assert rf is outs[0] and ri is outs[1]
+            np.testing.assert_array_equal(ff, rf)
+            np.testing.assert_array_equal(fi, ri)

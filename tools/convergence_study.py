@@ -237,9 +237,11 @@ def variants(wl, args):
     gs = getattr(args, "gossip_steps", 1)
     cw = getattr(args, "codec_warmup", 0)
     cr = getattr(args, "codec_refresh", 0)
-    choco = lambda comp, gamma=0.5, hh=h: LocalSGDConfig(  # noqa: E731
+    _g = getattr(args, "gamma", None)
+    base_gamma = 0.5 if _g is None else _g  # explicit --gamma 0 is a value
+    choco = lambda comp, gamma=base_gamma, hh=h, topo=ring: LocalSGDConfig(  # noqa: E731
         gossip=GossipConfig(
-            topology=ring, compressor=comp, gamma=gamma, gossip_steps=gs,
+            topology=topo, compressor=comp, gamma=gamma, gossip_steps=gs,
             codec_warmup_rounds=cw, codec_refresh_every=cr,
         ),
         optimizer=tx(),
@@ -280,6 +282,14 @@ def variants(wl, args):
         tor = topology_from_name("torus", world)
         out["exact torus"] = LocalSGDConfig(
             gossip=GossipConfig(topology=tor), optimizer=tx(), h=h
+        )
+        # the codec rows above ride the ring; this is the same shipped
+        # codec on the torus — the exact-vs-compressed comparison at the
+        # topology a 32-worker run actually wants (bert32: ring mixing is
+        # ~6x slower at world 32 and delays consensus learning past any
+        # affordable round budget)
+        out["choco topk+int8 torus"] = choco(
+            topk_int8_compressor(**ca), topo=tor
         )
     if args.h_sweep:
         for hh in H_SWEEP:
@@ -419,6 +429,10 @@ def main() -> None:
     ap.add_argument("--codec-refresh", type=int, default=0,
                     help="dense refresh round every K rounds (bounds top-k "
                          "error-feedback drift)")
+    ap.add_argument("--gamma", type=float, default=None,
+                    help="override the BASE choco gamma (0.5) for every "
+                         "codec row incl. the torus one — the gamma-sweep "
+                         "rows keep their own values")
     ap.add_argument("--codec-warmup", type=int, default=0,
                     help="exact-gossip warmup rounds before the codec "
                          "engages (CHOCO tracking warms during them)")

@@ -67,7 +67,8 @@ def _cast_state_adamw(lr, dtype):
     return optax.GradientTransformation(init, update)
 
 
-def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32") -> dict:
+def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32",
+                norm: str = "flax") -> dict:
     import functools
 
     import jax
@@ -77,7 +78,7 @@ def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32") -> dict:
 
     from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
 
-    cfg = GPT2Config(remat=remat)
+    cfg = GPT2Config(remat=remat, norm_impl=norm)
     model = GPT2LM(config=cfg)
     s = 1024
     rng = np.random.default_rng(0)
@@ -125,6 +126,7 @@ def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32") -> dict:
         "batch": batch,
         "remat": remat,
         "opt_state": opt,
+        "norm": norm,
         "tokens_sec": round(tokens_sec, 1),
         "step_ms": round(1000 * dt / steps, 2),
         "mfu": round(mfu, 4),
@@ -153,23 +155,29 @@ def main() -> None:
                     help="comma list of optimizer-state dtypes to sweep "
                          "(f32, bf16) — bf16 mu/nu halves optimizer HBM "
                          "traffic (VERDICT r3 item 9 lever)")
+    ap.add_argument("--norms", default="flax",
+                    help="comma list of LN impls to sweep (flax, pallas) "
+                         "— the fused-LN kernel (models/fused_ln.py, "
+                         "VERDICT r4 item 5b lever)")
     args = ap.parse_args()
 
     variants = []
     for b in (int(x) for x in args.batches.split(",")):
         for opt in args.opts.split(","):
-            if args.remat == "both":
-                variants += [(b, False, opt), (b, True, opt)]
-            elif args.remat == "auto":
-                variants.append((b, b > 8, opt))
-            else:
-                variants.append((b, args.remat == "on", opt))
+            for norm in args.norms.split(","):
+                if args.remat == "both":
+                    variants += [(b, False, opt, norm), (b, True, opt, norm)]
+                elif args.remat == "auto":
+                    variants.append((b, b > 8, opt, norm))
+                else:
+                    variants.append((b, args.remat == "on", opt, norm))
 
     rows = []
-    for batch, remat, opt in variants:
+    for batch, remat, opt, norm in variants:
         env = dict(os.environ)
         env["LM_SWEEP_ONE"] = json.dumps(
-            {"batch": batch, "remat": remat, "steps": args.steps, "opt": opt}
+            {"batch": batch, "remat": remat, "steps": args.steps, "opt": opt,
+             "norm": norm}
         )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker"],
@@ -185,6 +193,7 @@ def main() -> None:
                 "batch": batch,
                 "remat": remat,
                 "opt_state": opt,
+                "norm": norm,
                 "error": (proc.stderr or proc.stdout)[-400:],
             }
         rows.append(got)
@@ -203,6 +212,7 @@ if __name__ == "__main__":
                     spec["remat"],
                     spec["steps"],
                     spec.get("opt", "f32"),
+                    spec.get("norm", "flax"),
                 )
             ),
             flush=True,
