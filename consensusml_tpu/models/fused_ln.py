@@ -66,12 +66,16 @@ def _plan(m: int, h: int):
     """Rows-per-block for an (m, h) view, or None → jnp fallback.
 
     The whole hidden dim rides one block (row-local statistics), so h
-    must tile the 128-lane minor; bm targets ~2 MB bf16 blocks and must
-    divide m exactly (grids don't mask)."""
+    must tile the 128-lane minor and bm must divide m exactly (grids
+    don't mask). The cap budgets VMEM for the BACKWARD kernel's worst
+    case: ~6 f32 (bm, h) temporaries (xf/dyf/xhat/g + ins/outs) must sit
+    under the ~16 MB scoped limit, so bm*h is held to 2^18 elements
+    (≈ 6 MB of f32 temps + IO) — measured r5: 2^21/2 rows OOM'd Mosaic's
+    scoped vmem at h=1024."""
     if h % _LANE != 0 or m % 8 != 0:
         return None
     bm = 8
-    cap = max(8, 2**21 // (h * 2))
+    cap = max(8, 2**18 // h)
     while m % (bm * 2) == 0 and bm * 2 <= cap:
         bm *= 2
     return bm

@@ -201,6 +201,35 @@ def test_train_native_loader_with_data_dir(tmp_path):
     assert "final:" in r.stdout
 
 
+def test_train_native_wire_u8(tmp_path):
+    """--native-wire u8 ships quantized file bytes and dequants inside
+    the jitted step: training runs and the loss falls; non-image configs
+    and u8-without-native-loader fail fast with diagnostics."""
+    from consensusml_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not buildable here")
+    from tests.test_files_data import make_mnist_dir
+
+    make_mnist_dir(str(tmp_path / "m"), n_train=256)
+    metrics = tmp_path / "u8.jsonl"
+    r = _run(
+        ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+         "--rounds", "6", "--native-loader", "--native-wire", "u8",
+         "--data-dir", str(tmp_path / "m"), "--metrics-out", str(metrics)],
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert lines[-1]["loss"] < lines[0]["loss"]
+
+    r = _run(["train.py", "--config", "mnist_mlp", "--device", "cpu",
+              "--rounds", "2", "--native-wire", "u8"])
+    assert r.returncode == 2 and "requires --native-loader" in r.stderr
+    r = _run(["train.py", "--config", "bert_mlm", "--device", "cpu",
+              "--rounds", "2", "--native-loader", "--native-wire", "u8"])
+    assert r.returncode == 2 and "no u8-wire native path" in r.stderr
+
+
 def test_train_lr_schedule_flags(tmp_path):
     """--lr/--lr-schedule/--warmup-rounds/--grad-clip rebuild the config
     optimizer and still train (loss must improve under warmup+cosine)."""
