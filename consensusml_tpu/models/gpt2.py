@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from consensusml_tpu.models.attention import dot_product_attention
-from consensusml_tpu.models.losses import masked_lm_loss
+from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
 
 __all__ = ["GPT2Config", "GPT2LM", "gpt2_medium", "gpt2_loss_fn"]
 
@@ -39,6 +39,11 @@ class GPT2Config:
     # Pallas kernel (models/fused_ln.py). Parity-pinned; measured
     # keep/reject verdict in docs/perf.md — flax stays the default.
     norm_impl: str = "flax"
+    # >0: gpt2_loss_fn computes the LM cross-entropy via
+    # losses.chunked_vocab_lm_loss with this vocab chunk — the (B,S,V)
+    # logits tensor is never materialized (~2.5 GB of residuals at
+    # medium scale). 0 = dense logits (default); verdict in docs/perf.md.
+    loss_vocab_chunk: int = 0
 
     @property
     def mlp_dim(self) -> int:
@@ -86,7 +91,16 @@ class GPT2LM(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        deterministic: bool = True,
+        return_hidden: bool = False,
+    ) -> jax.Array:
+        """Logits (f32) by default; ``return_hidden=True`` returns the
+        pre-head states (post final-LN, model dtype) instead — the
+        chunked-vocab loss path computes the head inside the loss so the
+        full logits tensor is never materialized."""
         c = self.config
         b, s = input_ids.shape
         tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="wte")
@@ -103,24 +117,39 @@ class GPT2LM(nn.Module):
         for i in range(c.layers):
             x = block(c, name=f"h_{i}")(x, deterministic)
         x = _layer_norm(c, "ln_f")(x)
+        if return_hidden:
+            return jnp.asarray(x, c.dtype)
         logits = tok_emb.attend(jnp.asarray(x, tok_emb.dtype))
         return jnp.asarray(logits, jnp.float32)
 
 
 def gpt2_loss_fn(model: GPT2LM):
     """Next-token prediction: batch has ``input_ids`` (B, S); loss over all
-    positions predicting token t+1 (shift inside)."""
+    positions predicting token t+1 (shift inside). With
+    ``config.loss_vocab_chunk > 0`` the head runs inside
+    ``chunked_vocab_lm_loss`` and the logits tensor never exists."""
+    chunk = model.config.loss_vocab_chunk
 
     def loss_fn(params, model_state, batch, rng):
         ids = batch["input_ids"]
-        logits = model.apply(
-            {"params": params}, ids, deterministic=False, rngs={"dropout": rng}
-        )
         mask = batch.get("loss_mask")
         if mask is None:
             mask = jnp.ones_like(ids[:, 1:], jnp.float32)
         else:
             mask = mask[:, 1:]
+        if chunk > 0:
+            hidden = model.apply(
+                {"params": params}, ids, deterministic=False,
+                return_hidden=True, rngs={"dropout": rng},
+            )
+            loss = chunked_vocab_lm_loss(
+                hidden[:, :-1], params["wte"]["embedding"],
+                ids[:, 1:], mask, chunk=chunk,
+            )
+            return loss, model_state
+        logits = model.apply(
+            {"params": params}, ids, deterministic=False, rngs={"dropout": rng}
+        )
         return masked_lm_loss(logits[:, :-1], ids[:, 1:], mask), model_state
 
     return loss_fn

@@ -68,7 +68,7 @@ def _cast_state_adamw(lr, dtype):
 
 
 def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32",
-                norm: str = "flax") -> dict:
+                norm: str = "flax", loss: str = "dense") -> dict:
     import functools
 
     import jax
@@ -78,7 +78,10 @@ def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32",
 
     from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM, gpt2_loss_fn
 
-    cfg = GPT2Config(remat=remat, norm_impl=norm)
+    if loss not in ("dense", "chunked"):
+        raise ValueError(f"unknown loss impl {loss!r} (dense, chunked)")
+    cfg = GPT2Config(remat=remat, norm_impl=norm,
+                     loss_vocab_chunk=8192 if loss == "chunked" else 0)
     model = GPT2LM(config=cfg)
     s = 1024
     rng = np.random.default_rng(0)
@@ -127,6 +130,7 @@ def run_variant(batch: int, remat: bool, steps: int, opt: str = "f32",
         "remat": remat,
         "opt_state": opt,
         "norm": norm,
+        "loss_impl": loss,
         "tokens_sec": round(tokens_sec, 1),
         "step_ms": round(1000 * dt / steps, 2),
         "mfu": round(mfu, 4),
@@ -159,25 +163,32 @@ def main() -> None:
                     help="comma list of LN impls to sweep (flax, pallas) "
                          "— the fused-LN kernel (models/fused_ln.py, "
                          "VERDICT r4 item 5b lever)")
+    ap.add_argument("--losses", default="dense",
+                    help="comma list of LM-head loss impls to sweep "
+                         "(dense, chunked) — chunked never materializes "
+                         "the (B,S,V) logits (losses.chunked_vocab_lm_loss)")
     args = ap.parse_args()
 
     variants = []
     for b in (int(x) for x in args.batches.split(",")):
         for opt in args.opts.split(","):
             for norm in args.norms.split(","):
-                if args.remat == "both":
-                    variants += [(b, False, opt, norm), (b, True, opt, norm)]
-                elif args.remat == "auto":
-                    variants.append((b, b > 8, opt, norm))
-                else:
-                    variants.append((b, args.remat == "on", opt, norm))
+                for lo in args.losses.split(","):
+                    if args.remat == "both":
+                        variants += [
+                            (b, False, opt, norm, lo), (b, True, opt, norm, lo)
+                        ]
+                    elif args.remat == "auto":
+                        variants.append((b, b > 8, opt, norm, lo))
+                    else:
+                        variants.append((b, args.remat == "on", opt, norm, lo))
 
     rows = []
-    for batch, remat, opt, norm in variants:
+    for batch, remat, opt, norm, lo in variants:
         env = dict(os.environ)
         env["LM_SWEEP_ONE"] = json.dumps(
             {"batch": batch, "remat": remat, "steps": args.steps, "opt": opt,
-             "norm": norm}
+             "norm": norm, "loss": lo}
         )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker"],
@@ -194,6 +205,7 @@ def main() -> None:
                 "remat": remat,
                 "opt_state": opt,
                 "norm": norm,
+                "loss_impl": lo,
                 "error": (proc.stderr or proc.stdout)[-400:],
             }
         rows.append(got)
@@ -213,6 +225,7 @@ if __name__ == "__main__":
                     spec["steps"],
                     spec.get("opt", "f32"),
                     spec.get("norm", "flax"),
+                    spec.get("loss", "dense"),
                 )
             ),
             flush=True,
