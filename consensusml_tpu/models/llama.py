@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from consensusml_tpu.models.attention import apply_rope, dot_product_attention, rope_frequencies
-from consensusml_tpu.models.losses import masked_lm_loss
+from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
 
 __all__ = ["LlamaConfig", "LlamaLM", "llama2_7b", "llama_tiny", "llama_loss_fn"]
 
@@ -41,6 +41,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     lora_rank: int = 0  # 0 = plain dense projections
     lora_alpha: float = 16.0
+    # >0: llama_loss_fn computes the untied-head cross-entropy via
+    # losses.chunked_vocab_lm_loss — the (B,S,V) logits never
+    # materialize (the dominant activation at the 32k vocab; see
+    # docs/perf.md "Chunked-vocab LM loss"). 0 = dense (default).
+    loss_vocab_chunk: int = 0
     dtype: Any = jnp.bfloat16
 
     @property
@@ -139,28 +144,54 @@ class LlamaLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        deterministic: bool = True,
+        return_hidden: bool = False,
+    ) -> jax.Array:
         c = self.config
         x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
         rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
         for i in range(c.layers):
             x = _LlamaBlock(c, name=f"layer_{i}")(x, rope_table)
         x = RMSNorm(c.norm_eps, name="final_norm")(x)
-        logits = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
-        return jnp.asarray(logits, jnp.float32)
+        head = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")
+        if return_hidden:  # chunked-loss path: head runs inside the loss
+            # the head params must exist in EVERY init mode (the chunked
+            # loss reads params["lm_head"] directly); a one-token call
+            # creates them and XLA dead-code-eliminates it at runtime
+            head(x[:, :1])
+            return jnp.asarray(x, c.dtype)
+        return jnp.asarray(head(x), jnp.float32)
 
 
 def llama_loss_fn(model: LlamaLM):
-    """Causal next-token loss; batch: ``input_ids`` (+ optional loss_mask)."""
+    """Causal next-token loss; batch: ``input_ids`` (+ optional loss_mask).
+
+    ``config.loss_vocab_chunk > 0`` routes through the chunked-vocab
+    loss: the untied lm_head kernel (H, V) rides in as its transpose —
+    one extra (V, H) copy per pass (~0.5 GB at 7B, vs the ~2 GB of
+    logits it deletes)."""
+    chunk = model.config.loss_vocab_chunk
 
     def loss_fn(params, model_state, batch, rng):
         ids = batch["input_ids"]
-        logits = model.apply({"params": params}, ids)
         mask = batch.get("loss_mask")
         if mask is None:
             mask = jnp.ones_like(ids[:, 1:], jnp.float32)
         else:
             mask = mask[:, 1:]
+        if chunk > 0:
+            hidden = model.apply({"params": params}, ids, return_hidden=True)
+            return (
+                chunked_vocab_lm_loss(
+                    hidden[:, :-1], params["lm_head"]["kernel"].T,
+                    ids[:, 1:], mask, chunk=chunk,
+                ),
+                model_state,
+            )
+        logits = model.apply({"params": params}, ids)
         return masked_lm_loss(logits[:, :-1], ids[:, 1:], mask), model_state
 
     return loss_fn

@@ -53,7 +53,10 @@ def chunked_vocab_lm_loss(
 
     ``hidden``: (..., H) pre-head states (post final-LN, model dtype);
     ``embedding``: (V, H) tied embedding table; ``labels``/``mask``
-    broadcast over ``hidden[..., 0]``'s shape. The chunk matmul runs in
+    must carry exactly ``hidden[..., 0].size`` elements (they are
+    flattened, NOT broadcast — unlike dense ``masked_lm_loss``, a
+    scalar/broadcastable mask is a reshape error here). The chunk
+    matmul runs in
     the model dtype and upcasts per-chunk to f32, matching the dense
     path's ``attend``-then-``asarray(f32)`` exactly.
     """

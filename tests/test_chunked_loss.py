@@ -112,3 +112,39 @@ def test_loss_mask_respected():
     full = chunked_vocab_lm_loss(hidden[:2], emb, labels[:2], m1[:2], chunk=20)
     masked = chunked_vocab_lm_loss(hidden, emb, labels, m1, chunk=20)
     np.testing.assert_allclose(float(full), float(masked), rtol=1e-6)
+
+
+def test_llama_loss_fn_parity():
+    """Llama's UNTIED head (lm_head kernel (H, V), passed transposed)
+    matches the dense path on identical params, f32 dtype."""
+    from consensusml_tpu.models.llama import LlamaConfig, LlamaLM, llama_loss_fn
+
+    kw = dict(
+        vocab_size=90, hidden=48, layers=2, heads=4, kv_heads=2,
+        mlp_dim=96, max_len=32, dtype=jnp.float32,
+    )
+    m_dense = LlamaLM(config=LlamaConfig(**kw))
+    m_chunk = LlamaLM(config=LlamaConfig(loss_vocab_chunk=32, **kw))
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(0, 90, size=(2, 12)), jnp.int32
+    )
+    params = m_dense.init(jax.random.key(0), ids)["params"]
+    batch = {"input_ids": ids}
+    rng = jax.random.key(1)
+
+    def run(model):
+        fn = llama_loss_fn(model)
+        return jax.value_and_grad(lambda p: fn(p, {}, batch, rng)[0])(params)
+
+    ld, gd = run(m_dense)
+    lc, gc = run(m_chunk)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=2e-5)
+    for (ka, va), (kb, vb) in zip(
+        jax.tree_util.tree_leaves_with_path(gc),
+        jax.tree_util.tree_leaves_with_path(gd),
+    ):
+        assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(vb), atol=2e-4, rtol=2e-3,
+            err_msg=jax.tree_util.keystr(ka),
+        )
