@@ -218,6 +218,7 @@ def variants(wl, args):
     import optax  # noqa: F401  (opt factories resolve it lazily)
 
     from consensusml_tpu.compress import (
+        PallasInt8Compressor,
         QSGD4Compressor,
         topk_int4_compressor,
         topk_int8_compressor,
@@ -258,9 +259,7 @@ def variants(wl, args):
         "choco topk+int4": choco(topk_int4_compressor(**ca)),
         "choco qsgd4": choco(QSGD4Compressor(chunk=ca["chunk"])),
         "choco int8 (quant only)": choco(
-            __import__(
-                "consensusml_tpu.compress", fromlist=["PallasInt8Compressor"]
-            ).PallasInt8Compressor(chunk=ca["chunk"])
+            PallasInt8Compressor(chunk=ca["chunk"])
         ),
         "push-sum one-peer (directed)": LocalSGDConfig(
             gossip=GossipConfig(
@@ -283,14 +282,20 @@ def variants(wl, args):
         out["exact torus"] = LocalSGDConfig(
             gossip=GossipConfig(topology=tor), optimizer=tx(), h=h
         )
-        # the codec rows above ride the ring; this is the same shipped
-        # codec on the torus — the exact-vs-compressed comparison at the
-        # topology a 32-worker run actually wants (bert32: ring mixing is
-        # ~6x slower at world 32 and delays consensus learning past any
-        # affordable round budget)
+        # the codec rows above ride the ring; these re-run codecs on the
+        # torus — the exact-vs-compressed comparison at the topology a
+        # 32-worker run actually wants (bert32: ring mixing is ~6x
+        # slower at world 32 and delays consensus learning past any
+        # affordable round budget). The dense-codec torus rows ask the
+        # world-32 accuracy question top-k failed (docs/convergence.md):
+        # does a codec without never-shipped coordinates cross the cliff?
         out["choco topk+int8 torus"] = choco(
             topk_int8_compressor(**ca), topo=tor
         )
+        out["choco int8 (quant only) torus"] = choco(
+            PallasInt8Compressor(chunk=ca["chunk"]), topo=tor
+        )
+        out["choco qsgd4 torus"] = choco(QSGD4Compressor(chunk=ca["chunk"]), topo=tor)
     if args.h_sweep:
         for hh in H_SWEEP:
             if hh == h:
