@@ -230,6 +230,28 @@ def test_train_native_wire_u8(tmp_path):
     assert r.returncode == 2 and "no u8-wire native path" in r.stderr
 
 
+def test_train_native_wire_u8_checkpoint_resume(tmp_path):
+    """u8 wire composes with checkpoint/resume: the resumed run re-binds
+    the u8 source at the recorded round offset (start_seq keeps the
+    byte stream exact) and keeps training."""
+    from consensusml_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not buildable here")
+    from tests.test_files_data import make_mnist_dir
+
+    make_mnist_dir(str(tmp_path / "m"), n_train=256)
+    ck = tmp_path / "ckpt"
+    base = ["train.py", "--config", "mnist_mlp", "--device", "cpu",
+            "--native-loader", "--native-wire", "u8",
+            "--data-dir", str(tmp_path / "m")]
+    r1 = _run(base + ["--rounds", "3", "--checkpoint-dir", str(ck)])
+    assert r1.returncode == 0, r1.stderr[-800:]
+    r2 = _run(base + ["--rounds", "2", "--resume", str(ck / "step_3")])
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "resumed from" in r2.stdout and "final:" in r2.stdout
+
+
 def test_train_lr_schedule_flags(tmp_path):
     """--lr/--lr-schedule/--warmup-rounds/--grad-clip rebuild the config
     optimizer and still train (loss must improve under warmup+cosine)."""
