@@ -62,26 +62,44 @@ PROXY_BASELINE_IMGS_SEC_CHIP = 2500.0
 FINAL_LINE_LIMIT = 800
 
 
+# dropped (in order) once the note is exhausted and the line STILL
+# overflows; "value" is the one field the driver cannot do without, so it
+# is never dropped
+_OPTIONAL_FINAL_FIELDS = ("note", "elapsed_s", "unit", "vs_baseline", "metric")
+
+
 def build_final_line(payload: dict, limit: int = FINAL_LINE_LIMIT) -> str:
     """Serialize the headline payload to one JSON line <= limit bytes.
 
-    Only the free-text "note" field is trimmed; numeric fields are never
-    dropped. Trimming is overshoot-driven and re-measured after each cut,
-    so JSON escaping (which can expand characters) cannot sneak the line
-    back over the limit.
+    The free-text "note" field is trimmed first; if the line still
+    overflows (e.g. a caller stuffed an enormous metric name), optional
+    fields are dropped in _OPTIONAL_FINAL_FIELDS order, and as a last
+    resort the serialized line is hard-truncated at the byte limit — an
+    over-window line the driver tail-loses entirely is strictly worse
+    than a clipped one. Trimming is overshoot-driven and re-measured
+    after each cut, so JSON escaping (which can expand characters) cannot
+    sneak the line back over the limit.
     """
     payload = dict(payload)
     line = json.dumps(payload)
     while len(line.encode("utf-8")) > limit:
         note = str(payload.get("note", ""))
         if not note:
-            break  # nothing left to trim; fixed fields alone fit in practice
+            break
         overshoot = len(line.encode("utf-8")) - limit
         trimmed = note[: max(0, len(note) - max(overshoot, 1) - 3)].rstrip() + "..."
         if trimmed == note:
             trimmed = ""
         payload["note"] = trimmed
         line = json.dumps(payload)
+    for field in _OPTIONAL_FINAL_FIELDS:
+        if len(line.encode("utf-8")) <= limit:
+            break
+        if field in payload:
+            del payload[field]
+            line = json.dumps(payload)
+    if len(line.encode("utf-8")) > limit:
+        line = line.encode("utf-8")[:limit].decode("utf-8", errors="ignore")
     return line
 
 
@@ -551,6 +569,7 @@ def _gossip_round_bench() -> dict:
             )
         )
         label = "gpt2-smoke (cpu)"
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
     from consensusml_tpu.consensus.engine import _ravel_tree
 
     params = model.init(
@@ -560,14 +579,23 @@ def _gossip_round_bench() -> dict:
     comp = topk_int8_compressor(chunk=512, k=8, impl="auto")
     topo = RingTopology(8)
     gamma, steps = 0.5, 10
+    engine = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=gamma)
+    )
+    plan = engine.bucket_plan(params)  # the default (bucketed) wire layout
+    leaves, treedef = jax.tree.flatten(params)
 
-    def choco_round(fused):
+    def choco_round(mode):
         # the per-worker math of ConsensusEngine._phase_collective, with
-        # q standing in for each neighbor's payload (same shapes/ops)
+        # q standing in for each neighbor's payload (same shapes/ops);
+        # "bucketed" mirrors the engine exactly: params packed in/out of
+        # the round, xhat/s living per-bucket across rounds
         def body(carry, _):
             x, xhat, s = carry
-            if fused:
+            if mode == "fused":
                 x, unravel = _ravel_tree(x)
+            elif mode == "bucketed":
+                x = plan.pack(jax.tree.leaves(x))
             delta = jax.tree.map(jnp.subtract, x, xhat)
             q = comp.compress_tree(delta)
             dec_q = comp.decompress_tree(q, like=delta)
@@ -579,22 +607,26 @@ def _gossip_round_bench() -> dict:
             x = jax.tree.map(
                 lambda xi, si, hi: xi + gamma * (si - hi), x, s, xhat
             )
-            if fused:
+            if mode == "fused":
                 x = unravel(x)
+            elif mode == "bucketed":
+                x = jax.tree.unflatten(treedef, plan.unpack(x))
             return (x, xhat, s), jnp.float32(0)
 
         return body
 
-    def run(fused: bool) -> float:
+    def run(mode: str) -> float:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def multi(carry):
-            return jax.lax.scan(choco_round(fused), carry, None, length=steps)
+            return jax.lax.scan(choco_round(mode), carry, None, length=steps)
 
         # explicit copy: params are already f32, and asarray would alias
         # buffers the previous run's donate_argnums has deleted
         x0 = jax.tree.map(lambda v: jnp.array(v, jnp.float32, copy=True), params)
-        if fused:
+        if mode == "fused":
             zeros = jnp.zeros((n_params,), jnp.float32)
+        elif mode == "bucketed":
+            zeros = [jnp.zeros((b.total,), jnp.float32) for b in plan.buckets]
         else:
             zeros = jax.tree.map(
                 lambda v: jnp.zeros_like(v, jnp.float32), params
@@ -607,27 +639,33 @@ def _gossip_round_bench() -> dict:
         float(jax.tree.leaves(carry[0])[0][0])  # fence
         return 1000 * (time.time() - t0) / steps
 
-    per_leaf_ms = run(False)
+    # both engine paths: bucketed (the shipped default since the
+    # bucketing PR) and per-leaf (the bucket_bytes=None fallback)
+    bucketed_ms = run("bucketed")
+    per_leaf_ms = run("per_leaf")
     out = {
         "model": label,
         "params": n_params,
         "leaves": len(jax.tree.leaves(params)),
+        "buckets": plan.num_buckets,
+        "bucket_bytes": engine.config.bucket_bytes,
         "platform": jax.default_backend(),
         "codec": "topk8/512+int8 (pallas auto)",
-        "gossip_round_ms": round(per_leaf_ms, 2),  # per-leaf: the shipped path
+        "gossip_round_ms": round(bucketed_ms, 2),  # bucketed: the default
+        "per_leaf_round_ms": round(per_leaf_ms, 2),
     }
     # the rejected fused-tree variant costs a second full compile each
     # run; measure it only on request (the 85 vs 134 ms comparison is
     # recorded in docs/perf.md)
     if os.environ.get("BENCH_GOSSIP_FUSED"):
-        out["fused_tree_round_ms"] = round(run(True), 2)
-    # the default engine path is per-leaf (GossipConfig.fused_codec=False
-    # — measured faster; see docs/perf.md): wire accounting matches it
-    wire = sum(
+        out["fused_tree_round_ms"] = round(run("fused"), 2)
+    per_leaf_wire = sum(
         comp.wire_bytes(x.shape, jnp.float32) for x in jax.tree.leaves(params)
     )
+    wire = engine.wire_bytes_per_round(params) // len(topo.shifts)
     out.update(
         wire_bytes_per_neighbor=wire,
+        per_leaf_wire_bytes=per_leaf_wire,
         dense_bytes=n_params * 4,
         compression_x=round(n_params * 4 / wire, 1),
     )

@@ -27,6 +27,7 @@ __all__ = [
     "ppermute_shift",
     "mix",
     "mix_tree",
+    "mix_buckets",
     "mix_masked",
     "mix_tree_masked",
     "consensus_error",
@@ -70,6 +71,45 @@ def mix(x: jax.Array, topology: Topology) -> jax.Array:
 
 def mix_tree(tree: Any, topology: Topology) -> Any:
     return jax.tree.map(lambda x: mix(x, topology), tree)
+
+
+def mix_buckets(
+    bufs: list[jax.Array],
+    topology: Topology,
+    alive: jax.Array | None = None,
+    alive_nbrs: list[jax.Array] | None = None,
+) -> list[jax.Array]:
+    """One gossip round over a list of flat bucket buffers (see
+    :mod:`consensusml_tpu.consensus.bucketing`).
+
+    Per buffer this is exactly :func:`mix` (or :func:`mix_masked` when
+    ``alive`` is given) — bit-identical elementwise math — but every
+    bucket's sends are issued BEFORE any bucket's weighted combine, so
+    while bucket ``i`` is in flight on the ICI the scheduler is free to
+    run bucket ``i+1``'s sends and bucket ``i-1``'s combine: the
+    compute/comm pipeline DDP-style bucketing exists for. ``alive_nbrs``
+    caches the per-shift ppermuted flags (exchange them once per round,
+    not once per bucket).
+    """
+    if alive is not None:
+        if not topology.uses_psum and alive_nbrs is None:
+            alive_nbrs = [
+                ppermute_shift(alive, topology, s) for s in topology.shifts
+            ]
+        return [mix_masked(b, topology, alive, alive_nbrs) for b in bufs]
+    if topology.uses_psum:
+        return [jax.lax.pmean(b, topology.axis_names) for b in bufs]
+    inflight = [
+        [ppermute_shift(b, topology, s) for b in bufs]
+        for s in topology.shifts
+    ]
+    out = []
+    for i, b in enumerate(bufs):
+        acc = jnp.asarray(b, jnp.float32) * topology.self_weight
+        for s, recvs in zip(topology.shifts, inflight):
+            acc = acc + s.weight * jnp.asarray(recvs[i], jnp.float32)
+        out.append(acc.astype(b.dtype))
+    return out
 
 
 def mix_masked(x: jax.Array, topology: Topology, alive: jax.Array,

@@ -144,6 +144,21 @@ class Compressor(abc.ABC):
 
     stochastic: bool = False
 
+    def bucket_alignment(self) -> int | None:
+        """Element alignment under which leaf-aligned bucket packing
+        preserves this codec's per-leaf semantics (see
+        :mod:`consensusml_tpu.consensus.bucketing`).
+
+        Chunked codecs return their chunk size: when every leaf starts at
+        a chunk boundary inside a bucket, chunk-local selection and
+        per-chunk scales see exactly the per-leaf elements, and zero
+        padding decodes to zero. ``None`` (the default) means the codec's
+        semantics do NOT decompose per-chunk (global per-tensor top-k,
+        low-rank factorization, codecs whose decode of 0 is nonzero) and
+        the consensus engine must keep the per-leaf path for it.
+        """
+        return None
+
     @abc.abstractmethod
     def compress(self, x: jax.Array):
         ...
@@ -222,6 +237,9 @@ def _payload_leaves(payload_tree: Any, like: Any) -> list:
 class IdentityCompressor(Compressor):
     """No-op codec: exact gossip expressed through the compressed path."""
 
+    def bucket_alignment(self) -> int | None:
+        return 1  # elementwise: any packing preserves semantics
+
     def compress(self, x: jax.Array):
         return x
 
@@ -246,6 +264,13 @@ class ComposedCompressor(Compressor):
     @property
     def stochastic(self) -> bool:  # type: ignore[override]
         return self.inner.stochastic or self.outer.stochastic
+
+    def bucket_alignment(self) -> int | None:
+        # the INNER codec sees the bucket layout; the outer codec only
+        # quantizes the (already-selected) values vector, whose regrouping
+        # under bucketing is a quantization-noise-level change, not a
+        # selection change — so the inner codec's alignment governs
+        return self.inner.bucket_alignment()
 
     def compress(self, x: jax.Array, rng: jax.Array | None = None):
         if self.stochastic and rng is None:

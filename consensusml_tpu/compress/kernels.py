@@ -458,6 +458,9 @@ class PallasInt8Compressor(Compressor):
         if self.chunk % _LANE:
             raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
 
+    def bucket_alignment(self) -> int | None:
+        return self.chunk  # per-chunk scales decompose at chunk boundaries
+
     def compress(self, x: jax.Array) -> Int8Payload:
         n = x.size
         chunk = min(self.chunk, _round_up(n, _LANE))
@@ -502,6 +505,9 @@ class PallasInt4Compressor(Compressor):
     def __post_init__(self):
         if self.chunk % _LANE:
             raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
+
+    def bucket_alignment(self) -> int | None:
+        return self.chunk  # _LANE-multiple chunks are always even
 
     def compress(self, x: jax.Array) -> Int4Payload:
         n = x.size
@@ -575,6 +581,12 @@ class ChunkedTopKCompressor(Compressor):
                 f"chunk must be <= {2**16} (got {self.chunk}); pass "
                 "narrow_indices=False for wider chunks"
             )
+
+    def bucket_alignment(self) -> int | None:
+        # selection is chunk-local: with every leaf chunk-aligned inside a
+        # bucket, each chunk sees exactly one leaf's elements (plus inert
+        # zero padding), so the decoded result matches the per-leaf path
+        return self.chunk
 
     def compress(self, x: jax.Array) -> TopKPayload:
         flat = jnp.asarray(x.reshape(-1), jnp.float32)

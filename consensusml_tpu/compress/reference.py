@@ -108,6 +108,9 @@ class Int8Compressor(Compressor):
 
     chunk: int = 256
 
+    def bucket_alignment(self) -> int | None:
+        return self.chunk  # per-chunk scales decompose at chunk boundaries
+
     def compress(self, x: jax.Array) -> Int8Payload:
         chunks, scales, inv, chunk = chunk_for_quantization(x, self.chunk)
         q = jnp.clip(jnp.rint(chunks * inv[:, None]), -127, 127).astype(jnp.int8)
@@ -137,6 +140,9 @@ class Int4Compressor(Compressor):
     """
 
     chunk: int = 256
+
+    def bucket_alignment(self) -> int | None:
+        return self.chunk + self.chunk % 2  # the even_chunk effective width
 
     def compress(self, x: jax.Array) -> Int4Payload:
         chunks, scales, inv, chunk = chunk_for_quantization(

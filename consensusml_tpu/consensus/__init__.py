@@ -10,6 +10,11 @@ while consensus still converges; plain gossip is the identity-compressor
 special case.
 """
 
+from consensusml_tpu.consensus.bucketing import (  # noqa: F401
+    Bucket,
+    BucketPlan,
+    build_plan,
+)
 from consensusml_tpu.consensus.engine import (  # noqa: F401
     ChocoState,
     OverlapState,

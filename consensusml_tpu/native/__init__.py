@@ -328,7 +328,33 @@ class NativeLoader:
 
         ``out``: optional (data, ints) numpy pair to copy INTO (rotating
         reusable buffers let the backend's transfer path reuse staging
-        state instead of seeing a fresh allocation every round)."""
+        state instead of seeing a fresh allocation every round). It must
+        match this loader's slot layout exactly — a silent fallback to a
+        fresh copy here would hide the exact bug reusable buffers exist
+        to avoid (the transfer path re-staging every round)."""
+        wire_dtype = np.uint8 if self._wire == "u8" else np.float32
+        if out is not None:
+            if not isinstance(out, (tuple, list)) or len(out) != 2:
+                raise ValueError(
+                    "next(out=...) takes a (data, ints) pair of ndarrays, "
+                    f"got {type(out).__name__} of length "
+                    f"{len(out) if isinstance(out, (tuple, list)) else 'n/a'}"
+                )
+            for name, arr, shape, dtype in (
+                ("data", out[0], self._shape_f, wire_dtype),
+                ("ints", out[1], self._shape_i, np.int32),
+            ):
+                if not isinstance(arr, np.ndarray):
+                    raise ValueError(
+                        f"next(out=...) {name} buffer must be a numpy "
+                        f"ndarray, got {type(arr).__name__}"
+                    )
+                if tuple(arr.shape) != shape or arr.dtype != np.dtype(dtype):
+                    raise ValueError(
+                        f"next(out=...) {name} buffer mismatch: expected "
+                        f"shape {shape} dtype {np.dtype(dtype).name}, got "
+                        f"shape {tuple(arr.shape)} dtype {arr.dtype.name}"
+                    )
         data_p = _u8p() if self._wire == "u8" else _f32p()
         iptr = _i32p()
         acquire = (
@@ -339,7 +365,7 @@ class NativeLoader:
         idx = acquire(self._h, ctypes.byref(data_p), ctypes.byref(iptr))
         if idx < 0:
             raise RuntimeError("loader stopped")
-        dtype = np.uint8 if self._wire == "u8" else np.float32
+        dtype = wire_dtype
 
         def _copy(ptr, shape, dt, dst):
             if 0 in shape:  # empty buffer: C++ data() may be NULL

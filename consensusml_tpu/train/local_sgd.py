@@ -67,8 +67,21 @@ class LocalSGDConfig:
     optimizer: optax.GradientTransformation
     h: int = 1  # local (inner) steps between gossip rounds
     outer: SlowMoConfig | None = None  # None => mixed params used as-is
+    # gossip-wire bucketing knob, surfaced here so training configs and
+    # the CLI override it in one place: anything but the "inherit"
+    # sentinel replaces gossip.bucket_bytes (None or 0 => per-leaf wire;
+    # see GossipConfig.bucket_bytes for the semantics)
+    bucket_bytes: int | None | str = "inherit"
 
     def __post_init__(self):
+        if self.bucket_bytes != "inherit":
+            object.__setattr__(
+                self,
+                "gossip",
+                dataclasses.replace(
+                    self.gossip, bucket_bytes=self.bucket_bytes or None
+                ),
+            )
         if self.gossip.overlap and self.outer is not None:
             raise NotImplementedError(
                 "overlap gossip + SlowMo is not supported: SlowMo's slow "
@@ -287,7 +300,9 @@ def make_collective_train_step(
             z = engine.apply_correction(
                 _gossiped(state.params, state.model_state), state.gossip
             )
-            gossip = engine.correction_collective(z, step=state.step)
+            gossip = engine.correction_collective(
+                z, state.gossip, step=state.step
+            )
             # post-gossip measurement point, same as every other mode:
             # z is the params right after the mixing correction landed
             err = engine.consensus_error_collective(z["params"])
@@ -506,7 +521,7 @@ def make_simulated_train_step(
             z = engine.apply_correction(
                 _gossiped(state.params, state.model_state), state.gossip
             )
-            gossip = engine.correction_simulated(z, w)
+            gossip = engine.correction_simulated(z, w, state.gossip)
             # post-gossip measurement point, same as every other mode
             err = engine.consensus_error_simulated(z["params"])
             params, model_state, opt_state, rng, losses = jax.vmap(worker)(

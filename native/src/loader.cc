@@ -321,7 +321,9 @@ void* cml_loader_create(int depth, int nthreads, uint64_t seed, int kind,
   if (kind == 1 && (successors == nullptr || nclasses_or_vocab < 2)) return nullptr;
   if (nclasses_or_vocab < 1) return nullptr;
   if (float_bytes != 4 && float_bytes != 1) return nullptr;
-  if (float_bytes == 1 && qscale <= 0.0f) return nullptr;
+  // u8 wire quantizes the FLOAT payload; only the classification kind (0)
+  // has one — mirrors the cml_loader_create_file guard (kind 2 only)
+  if (float_bytes == 1 && (kind != 0 || qscale <= 0.0f)) return nullptr;
   return new cml::Loader(depth, nthreads, seed, kind, samples_per_slot,
                          sample_floats, sample_ints, nclasses_or_vocab, noise,
                          prototypes, successors, /*world=*/1, nullptr, nullptr,

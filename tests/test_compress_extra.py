@@ -194,8 +194,9 @@ def test_filtered_compressed_gossip():
         "adapter": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32),
         "frozen": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32),
     }
-    state = engine.init_state(params)
-    assert len(jax.tree.leaves(state.xhat)) == 1  # adapters only
+    # stacked params: bucketed CHOCO buffers need the worker count
+    state = engine.init_state(params, world_size=4)
+    assert len(jax.tree.leaves(state.xhat)) == 1  # adapters only (1 bucket)
 
     w = jnp.asarray(topo.mixing_matrix(), jnp.float32)
     keys = jax.random.split(jax.random.key(7), 4)

@@ -68,9 +68,14 @@ def test_codec_terms_present_only_for_compressed_configs():
     mlp = hbm_model.predict("mnist_mlp", "smoke")["per_device"]
     assert gpt2["codec_temp"] > 0 and gpt2["payloads"] > 0
     assert mlp["codec_temp"] == 0 and mlp["payloads"] == 0
-    # CHOCO keeps xhat+s: gossip state is exactly 2x f32 params count
+    # CHOCO keeps xhat+s per wire bucket: exactly 2x the f32 compress
+    # domain with leaf sizes rounded up to the codec chunk (the bucketed
+    # state layout — docs/gossip_bucketing.md)
+    bundle = build("gpt2_topk", "smoke")
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    plan = bundle.cfg.engine().bucket_plan({"params": probe, "model_state": {}})
     n_params = gpt2["params"]  # f32 leaves
-    assert gpt2["gossip"] == 2 * n_params
+    assert gpt2["gossip"] == 2 * 4 * plan.total_elems >= 2 * n_params
 
 
 @pytest.mark.slow  # builds all five FULL bundles (llama-7B eval_shape)

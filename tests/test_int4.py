@@ -119,7 +119,8 @@ def test_composed_topk_int4_in_choco():
     rng = np.random.default_rng(4)
     x = {"w": jnp.asarray(rng.normal(size=(4, 16, 16)), jnp.float32)}
     err0 = float(engine.consensus_error_simulated(x))
-    state = engine.init_state(x)
+    # stacked params: bucketed CHOCO buffers need the worker count
+    state = engine.init_state(x, world_size=4)
     w = simulated.mixing_matrix(topo)
     for _ in range(40):
         x, state = engine.round_simulated(x, state, w)

@@ -118,7 +118,8 @@ def test_codec_in_choco_engine():
     rng = np.random.default_rng(5)
     x = {"w": jnp.asarray(rng.normal(size=(4, 16, 16)), jnp.float32)}
     err0 = float(engine.consensus_error_simulated(x))
-    state = engine.init_state(x)
+    # stacked params: bucketed/fused CHOCO buffers need the worker count
+    state = engine.init_state(x, world_size=4)
     w = simulated.mixing_matrix(topo)
     for _ in range(40):
         x, state = engine.round_simulated(x, state, w)

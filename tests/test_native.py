@@ -105,6 +105,46 @@ def test_loader_deterministic_across_thread_counts():
         np.testing.assert_array_equal(ia, ib)
 
 
+def test_loader_next_out_validated():
+    """next(out=...) rejects mismatched reuse buffers LOUDLY — a silent
+    fresh-copy fallback would defeat the staging reuse out= exists for."""
+    with _mk_loader() as ld:
+        good = (np.empty((8, 16), np.float32), np.empty((8, 1), np.int32))
+        data, ints = ld.next(out=good)
+        assert data is good[0] and ints is good[1]
+        with pytest.raises(ValueError, match=r"\(data, ints\) pair"):
+            ld.next(out=np.empty((8, 16), np.float32))
+        with pytest.raises(ValueError, match="ndarray"):
+            ld.next(out=([[0.0] * 16] * 8, good[1]))
+        with pytest.raises(ValueError, match="data buffer mismatch"):
+            ld.next(out=(np.empty((8, 15), np.float32), good[1]))
+        with pytest.raises(ValueError, match="data buffer mismatch"):
+            ld.next(out=(np.empty((8, 16), np.float64), good[1]))
+        with pytest.raises(ValueError, match="ints buffer mismatch"):
+            ld.next(out=(good[0], np.empty((8, 1), np.int64)))
+        # u8-wire loader expects uint8 data buffers
+        proto = np.arange(10 * 16, dtype=np.float32).reshape(10, 16) / 100.0
+        with native.NativeLoader(
+            kind="classification", samples_per_slot=8, sample_floats=16,
+            sample_ints=1, nclasses_or_vocab=10, prototypes=proto, wire="u8",
+        ) as u8:
+            with pytest.raises(ValueError, match="data buffer mismatch"):
+                u8.next(out=(np.empty((8, 16), np.float32), good[1]))
+            data, _ = u8.next(out=(np.empty((8, 16), np.uint8), good[1]))
+            assert data.dtype == np.uint8
+
+
+def test_loader_u8_wire_requires_classification_kind():
+    """cml_loader_create mirrors the create_file guard: the u8 wire
+    quantizes the float payload, which only kind 0 has."""
+    succ = np.zeros((10, 4), np.int32)
+    with pytest.raises(RuntimeError, match="cml_loader_create failed"):
+        native.NativeLoader(
+            kind="lm", samples_per_slot=4, sample_floats=0, sample_ints=16,
+            nclasses_or_vocab=10, successors=succ, wire="u8",
+        )
+
+
 def test_loader_seeds_differ():
     with _mk_loader(seed=1) as a, _mk_loader(seed=2) as b:
         fa, _ = a.next()

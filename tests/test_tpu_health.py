@@ -248,3 +248,31 @@ def test_bench_final_line_capped_worst_case():
         line = bench.build_final_line({**payload, "note": note})
         assert json.loads(line)["note"] == note
         assert len(line.encode()) <= bench.FINAL_LINE_LIMIT
+
+
+def test_bench_final_line_capped_even_without_note_to_trim():
+    """With the note exhausted, optional fields drop (in declared order)
+    until the line fits; "value" survives every cut. A pathological
+    payload that STILL overflows is byte-truncated — an over-window line
+    is lost entirely, a clipped one at least lands its head."""
+    bench = _bench_module()
+
+    huge_metric = "m" * 2000  # no note to trim: the metric itself overflows
+    payload = {
+        "metric": huge_metric,
+        "value": 2536.13,
+        "unit": "imgs/sec/chip",
+        "vs_baseline": 1.0144,
+        "elapsed_s": 2512.7,
+        "note": "",
+    }
+    line = bench.build_final_line(payload)
+    assert len(line.encode("utf-8")) <= bench.FINAL_LINE_LIMIT
+    out = json.loads(line)  # still valid JSON: the overflow field dropped
+    assert out["value"] == 2536.13
+    assert "metric" not in out
+
+    # un-droppable overflow (value itself too wide for a 16-byte limit):
+    # byte-truncation is the last resort — never a >limit line
+    line = bench.build_final_line({"value": 10.0 / 3.0, "x": "y" * 900}, limit=16)
+    assert len(line.encode("utf-8")) <= 16

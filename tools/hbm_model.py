@@ -226,7 +226,13 @@ def predict(
     gossip = jax.eval_shape(
         lambda p: engine.init_state(
             {"params": p, "model_state": model_state},
-            world_size=cfg.gossip.topology.world_size,
+            # the probe shapes are PER-WORKER: world_size only matters
+            # for the push-sum mass scalar — passing it otherwise would
+            # make the fused/bucketed CHOCO state misread the per-worker
+            # tree as stacked
+            world_size=(
+                cfg.gossip.topology.world_size if cfg.gossip.push_sum else None
+            ),
         ),
         params,
     )

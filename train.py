@@ -83,7 +83,14 @@ def parse_args(argv=None):
                    help="combine-then-adapt gossip: the mixing correction is "
                         "computed from pre-inner-loop params and applied next "
                         "round, letting XLA overlap the communication with "
-                        "the H local steps (exact gossip only)")
+                        "the H local steps (exact gossip, or compressed "
+                        "gossip on the bucketed wire)")
+    p.add_argument("--bucket-bytes", type=int, default=None,
+                   help="gossip wire bucket cap in bytes — leaves coalesce "
+                        "into fused wire buffers of roughly this much "
+                        "estimated traffic each (default 4 MiB; see "
+                        "GossipConfig.bucket_bytes). 0 = per-leaf wire "
+                        "(one collective per tree leaf)")
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
@@ -428,6 +435,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+    if args.bucket_bytes is not None:
+        import dataclasses
+
+        try:
+            # override the LocalSGDConfig-level knob, not gossip directly:
+            # a later replace() re-runs __post_init__, which re-applies
+            # the retained bucket_bytes field over the gossip sub-config
+            # (0 = the per-leaf wire)
+            bundle.cfg = dataclasses.replace(
+                bundle.cfg, bucket_bytes=args.bucket_bytes
+            )
+        except (NotImplementedError, ValueError) as e:
+            print(f"error: --bucket-bytes: {e}", file=sys.stderr)
+            return 2
     if args.overlap_gossip:
         import dataclasses
 
@@ -553,8 +574,18 @@ def main(argv=None) -> int:
     param_shapes = jax.eval_shape(bundle.init_params, jax.random.key(0))
     if isinstance(param_shapes, tuple) and len(param_shapes) == 2:
         param_shapes = param_shapes[0]  # (params, model_state) initializers
-    wire = bundle.cfg.engine().wire_bytes_per_round(param_shapes)
-    print(f"gossip wire: {wire / 1e6:.3f} MB/worker/round", flush=True)
+    engine = bundle.cfg.engine()
+    wire = engine.wire_bytes_per_round(param_shapes)
+    plan = engine.bucket_plan(param_shapes)
+    wire_layout = (
+        "per-leaf wire"
+        if plan is None
+        else f"{plan.num_buckets} wire bucket(s)"
+    )
+    print(
+        f"gossip wire: {wire / 1e6:.3f} MB/worker/round ({wire_layout})",
+        flush=True,
+    )
 
     # --native-wire u8: batches arrive as quantized uint8; the dequant
     # runs INSIDE the jitted step (on device) so the host->device wire
@@ -567,6 +598,15 @@ def main(argv=None) -> int:
         if not args.native_loader:
             print(
                 "error: --native-wire u8 requires --native-loader",
+                file=sys.stderr,
+            )
+            return 2
+        if bundle.native_batches is None:
+            # the accurate diagnosis comes first: without ANY native path
+            # the wire format is moot, and the u8-specific message below
+            # ("image workloads only") would misdirect the fix
+            print(
+                f"error: config {bundle.name} has no native loader path",
                 file=sys.stderr,
             )
             return 2
