@@ -659,6 +659,36 @@ def _gossip_round_bench() -> dict:
     # recorded in docs/perf.md)
     if os.environ.get("BENCH_GOSSIP_FUSED"):
         out["fused_tree_round_ms"] = round(run("fused"), 2)
+
+    # telemetry overhead: the obs layer's per-round HOST cost (one
+    # train.round span + latency observe + wire counter + consensus
+    # gauge — exactly what train.py adds per round) measured against the
+    # gossip round it annotates. Device work is untouched by telemetry
+    # (spans are named scopes inside jit), so host cost IS the overhead;
+    # the acceptance budget is <2% of a gossip round.
+    from consensusml_tpu.obs import get_registry, get_tracer
+
+    tracer = get_tracer()
+    reg = get_registry()
+    was_enabled = tracer.enabled
+    tracer.enabled = True
+    hist = reg.histogram("bench_round_latency_seconds")
+    wire_c = reg.counter("bench_wire_bytes_total")
+    cons_g = reg.gauge("bench_consensus_distance")
+    n_probe = 2000
+    t0 = time.time()
+    for i in range(n_probe):
+        with tracer.span("train.round", round=i):
+            pass
+        hist.observe(bucketed_ms / 1000)
+        wire_c.inc(1e6)
+        cons_g.set(0.5)
+    telem_ms = 1000 * (time.time() - t0) / n_probe
+    tracer.enabled = was_enabled
+    out["telemetry_per_round_ms"] = round(telem_ms, 4)
+    out["telemetry_overhead_pct"] = round(
+        100 * telem_ms / max(bucketed_ms, 1e-9), 3
+    )
     per_leaf_wire = sum(
         comp.wire_bytes(x.shape, jnp.float32) for x in jax.tree.leaves(params)
     )

@@ -21,7 +21,18 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from consensusml_tpu.obs import get_registry
+from consensusml_tpu.obs import span as _span
 from consensusml_tpu.topology import Shift, Topology
+
+# trace-time accounting: gossip programs are compiled once and replayed,
+# so the per-ROUND ppermute count IS the per-COMPILE count (the quantity
+# tests/test_bucketing.py jaxpr-asserts). Incremented while jit traces
+# this module — zero steady-state cost.
+_TRACED_PPERMUTES = get_registry().counter(
+    "consensusml_ppermutes_traced_total",
+    "ppermute collectives traced into gossip programs (per XLA compile)",
+)
 
 __all__ = [
     "ppermute_shift",
@@ -44,7 +55,9 @@ def ppermute_shift(x: jax.Array, topology: Topology, shift: Shift) -> jax.Array:
     n = topology.mesh_shape[shift.axis]
     axis_name = topology.axis_names[shift.axis]
     perm = [(s, (s + shift.offset) % n) for s in range(n)]
-    return jax.lax.ppermute(x, axis_name, perm)
+    _TRACED_PPERMUTES.inc()
+    with jax.named_scope("comm.ppermute"):
+        return jax.lax.ppermute(x, axis_name, perm)
 
 
 def ppermute_shift_tree(tree: Any, topology: Topology, shift: Shift) -> Any:
@@ -99,17 +112,19 @@ def mix_buckets(
         return [mix_masked(b, topology, alive, alive_nbrs) for b in bufs]
     if topology.uses_psum:
         return [jax.lax.pmean(b, topology.axis_names) for b in bufs]
-    inflight = [
-        [ppermute_shift(b, topology, s) for b in bufs]
-        for s in topology.shifts
-    ]
-    out = []
-    for i, b in enumerate(bufs):
-        acc = jnp.asarray(b, jnp.float32) * topology.self_weight
-        for s, recvs in zip(topology.shifts, inflight):
-            acc = acc + s.weight * jnp.asarray(recvs[i], jnp.float32)
-        out.append(acc.astype(b.dtype))
-    return out
+    with _span("comm.bucket_sends", buckets=len(bufs)):
+        inflight = [
+            [ppermute_shift(b, topology, s) for b in bufs]
+            for s in topology.shifts
+        ]
+    with _span("comm.bucket_combine"):
+        out = []
+        for i, b in enumerate(bufs):
+            acc = jnp.asarray(b, jnp.float32) * topology.self_weight
+            for s, recvs in zip(topology.shifts, inflight):
+                acc = acc + s.weight * jnp.asarray(recvs[i], jnp.float32)
+            out.append(acc.astype(b.dtype))
+        return out
 
 
 def mix_masked(x: jax.Array, topology: Topology, alive: jax.Array,

@@ -25,6 +25,7 @@ from consensusml_tpu.consensus.faults import (  # noqa: F401
     FaultConfig,
     draw_alive,
     masked_mixing_matrix,
+    record_fault_metrics,
     tree_all_finite,
 )
 from consensusml_tpu.consensus.pushsum import (  # noqa: F401

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from consensusml_tpu.comm import collectives, simulated
 from consensusml_tpu.compress.base import Compressor
+from consensusml_tpu.obs import span as _span
 from consensusml_tpu.consensus.bucketing import BucketPlan, build_plan
 from consensusml_tpu.consensus.faults import FaultConfig, masked_mixing_matrix
 from consensusml_tpu.consensus.pushsum import (
@@ -407,10 +408,15 @@ class ConsensusEngine:
         commutes with concatenation)."""
         if self.bucketed and leaves:
             plan = self._dense_plan(leaves)
-            bufs = plan.pack(leaves)
-            for _ in range(n_iter):
-                bufs = collectives.mix_buckets(bufs, topo, alive, alive_nbrs)
-            return plan.unpack(bufs)
+            with _span("bucket.pack", buckets=plan.num_buckets):
+                bufs = plan.pack(leaves)
+            with _span("bucket.mix", iters=n_iter):
+                for _ in range(n_iter):
+                    bufs = collectives.mix_buckets(
+                        bufs, topo, alive, alive_nbrs
+                    )
+            with _span("bucket.unpack"):
+                return plan.unpack(bufs)
         out = list(leaves)
         for _ in range(n_iter):
             if alive is not None:
@@ -439,10 +445,13 @@ class ConsensusEngine:
     ) -> list:
         if self.bucketed and leaves:
             plan = self._dense_plan(leaves, stacked=True)
-            bufs = plan.pack(leaves, stacked=True)
-            for _ in range(n_iter):
-                bufs = [simulated.mix_stacked(b, w) for b in bufs]
-            return plan.unpack(bufs, stacked=True)
+            with _span("bucket.pack", buckets=plan.num_buckets):
+                bufs = plan.pack(leaves, stacked=True)
+            with _span("bucket.mix", iters=n_iter):
+                for _ in range(n_iter):
+                    bufs = [simulated.mix_stacked(b, w) for b in bufs]
+            with _span("bucket.unpack"):
+                return plan.unpack(bufs, stacked=True)
         out = list(leaves)
         for _ in range(n_iter):
             out = [simulated.mix_stacked(x, w) for x in out]
@@ -623,7 +632,10 @@ class ConsensusEngine:
                 "counter (step=...)"
             )
         if not topo.is_time_varying:
-            return self._phase_collective(topo, params, state, alive, rng, step)
+            with _span("gossip.round", backend="collective"):
+                return self._phase_collective(
+                    topo, params, state, alive, rng, step
+                )
         if step is None:
             raise ValueError(
                 f"{type(topo).__name__} is time-varying: round_collective "
@@ -633,9 +645,10 @@ class ConsensusEngine:
             functools.partial(self._phase_collective, phase)
             for phase in topo.phases
         ]
-        return jax.lax.switch(
-            step % topo.period, branches, params, state, alive, rng, step
-        )
+        with _span("gossip.round", backend="collective", phases=topo.period):
+            return jax.lax.switch(
+                step % topo.period, branches, params, state, alive, rng, step
+            )
 
     def _phase_collective(
         self,
@@ -723,7 +736,8 @@ class ConsensusEngine:
             # buffers cross rounds without a repack.
             leaves, treedef = jax.tree.flatten(x)
             plan = self._codec_plan(leaves)
-            x = plan.pack(leaves)
+            with _span("bucket.pack", buckets=plan.num_buckets):
+                x = plan.pack(leaves)
             _check_bucket_state(x, xhat)
         def _track(x, xhat, s, it_rng):
             """One innovation exchange: update xhat and s."""
@@ -776,7 +790,8 @@ class ConsensusEngine:
         if plan is not None:
             # params back to leaves (padding slots drop); xhat/s stay
             # per-bucket — that IS their steady-state layout
-            x_new = jax.tree.unflatten(treedef, plan.unpack(x_new))
+            with _span("bucket.unpack"):
+                x_new = jax.tree.unflatten(treedef, plan.unpack(x_new))
         x_new = jax.tree.map(
             lambda new, old: new.astype(old.dtype), x_new, params
         )
@@ -794,31 +809,35 @@ class ConsensusEngine:
         ``s`` are matching pytrees — parameter leaves on the per-leaf
         path, flat bucket buffers on the bucketed path."""
         comp = self.config.compressor
-        delta = jax.tree.map(jnp.subtract, x, xhat)
-        q = comp.compress_tree(delta, rng)
-        dec_q = comp.decompress_tree(q, like=delta)
-        xhat = jax.tree.map(jnp.add, xhat, dec_q)
-        if topo.uses_psum:
-            recv = jax.tree.map(
-                lambda d: jax.lax.pmean(d, topo.axis_names), dec_q
-            )
-        else:
-            recv = jax.tree.map(lambda d: topo.self_weight * d, dec_q)
-            # issue every shift's sends up front: bucket i+1's compress
-            # has no data dependence on bucket i's in-flight ppermute, so
-            # the latency-hiding scheduler pipelines codec work under the
-            # wire (the DDP-style compute/comm overlap bucketing buys)
-            inflight = [
-                collectives.ppermute_shift_tree(q, topo, shift)
-                for shift in topo.shifts
-            ]
-            for shift, q_nbr in zip(topo.shifts, inflight):
-                # fused decompress-accumulate: sparse codecs scatter-add
-                # straight into recv — no dense per-neighbor temporary
-                recv = comp.decompress_accumulate_tree(
-                    q_nbr, recv, shift.weight
+        with _span("choco.innovation"):
+            delta = jax.tree.map(jnp.subtract, x, xhat)
+            with _span("choco.compress"):
+                q = comp.compress_tree(delta, rng)
+                dec_q = comp.decompress_tree(q, like=delta)
+            xhat = jax.tree.map(jnp.add, xhat, dec_q)
+            if topo.uses_psum:
+                recv = jax.tree.map(
+                    lambda d: jax.lax.pmean(d, topo.axis_names), dec_q
                 )
-        return xhat, jax.tree.map(jnp.add, s, recv)
+            else:
+                recv = jax.tree.map(lambda d: topo.self_weight * d, dec_q)
+                # issue every shift's sends up front: bucket i+1's compress
+                # has no data dependence on bucket i's in-flight ppermute, so
+                # the latency-hiding scheduler pipelines codec work under the
+                # wire (the DDP-style compute/comm overlap bucketing buys)
+                with _span("choco.exchange", shifts=len(topo.shifts)):
+                    inflight = [
+                        collectives.ppermute_shift_tree(q, topo, shift)
+                        for shift in topo.shifts
+                    ]
+                    for shift, q_nbr in zip(topo.shifts, inflight):
+                        # fused decompress-accumulate: sparse codecs
+                        # scatter-add straight into recv — no dense
+                        # per-neighbor temporary
+                        recv = comp.decompress_accumulate_tree(
+                            q_nbr, recv, shift.weight
+                        )
+            return xhat, jax.tree.map(jnp.add, s, recv)
 
     def _innovation_exchange_simulated(
         self, x: Any, xhat: Any, s: Any, w: jax.Array, rng: jax.Array | None
@@ -1008,6 +1027,18 @@ class ConsensusEngine:
         the collective backend makes. ``step``: round counter (required
         when ``codec_warmup_rounds > 0``).
         """
+        with _span("gossip.round", backend="simulated"):
+            return self._round_simulated(params, state, w, alive, rng, step)
+
+    def _round_simulated(
+        self,
+        params: Any,
+        state: ChocoState | None,
+        w: jax.Array,
+        alive: jax.Array | None = None,
+        rng: jax.Array | None = None,
+        step: jax.Array | None = None,
+    ):
         if step is None and (
             self.config.codec_warmup_rounds > 0
             or self.config.codec_refresh_every > 0
@@ -1072,7 +1103,8 @@ class ConsensusEngine:
             # per-bucket (init_state with world_size)
             leaves, treedef = jax.tree.flatten(x)
             plan = self._codec_plan(leaves, stacked=True)
-            x = plan.pack(leaves, stacked=True)
+            with _span("bucket.pack", buckets=plan.num_buckets):
+                x = plan.pack(leaves, stacked=True)
             _check_bucket_state(x, xhat)
 
         def _track(x, xhat, s, it_rng):
@@ -1124,9 +1156,10 @@ class ConsensusEngine:
             x_new = unravel(x_new)
         if plan is not None:
             # params back to leaves; xhat/s stay per-bucket
-            x_new = jax.tree.unflatten(
-                treedef, plan.unpack(x_new, stacked=True)
-            )
+            with _span("bucket.unpack"):
+                x_new = jax.tree.unflatten(
+                    treedef, plan.unpack(x_new, stacked=True)
+                )
         x_new = jax.tree.map(lambda new, old: new.astype(old.dtype), x_new, params)
         if rebuild_split is not None:
             x_new = rebuild_split(
@@ -1195,16 +1228,21 @@ class ConsensusEngine:
                 sum(leaf_bytes(x) for x in jax.tree.leaves(params))
                 + exact_payload
             )
-        topo = self.topology
-        if topo.is_time_varying:
-            sends = sum(
-                (1 if p.uses_psum else len(p.shifts)) for p in topo.phases
-            ) / topo.period
-        else:
-            sends = 1 if topo.uses_psum else len(topo.shifts)
+        sends = self._sends_per_round()
         mass = 4 * sends if self.config.push_sum else 0
         # every extra consensus iteration ships a fresh payload
         return int(payload * sends * self.config.gossip_steps + mass)
+
+    def _sends_per_round(self) -> float:
+        """Neighbor sends per round (psum counts 1; time-varying
+        topologies report the per-period average) — the one definition
+        both the wire accounting and telemetry() divide by."""
+        topo = self.topology
+        if topo.is_time_varying:
+            return sum(
+                (1 if p.uses_psum else len(p.shifts)) for p in topo.phases
+            ) / topo.period
+        return 1 if topo.uses_psum else len(topo.shifts)
 
     # ---- metrics --------------------------------------------------------
     def consensus_error_collective(
@@ -1214,3 +1252,52 @@ class ConsensusEngine:
 
     def consensus_error_simulated(self, params: Any) -> jax.Array:
         return simulated.consensus_error_stacked(params, self.topology.world_size)
+
+    # ---- telemetry ------------------------------------------------------
+    def telemetry(self, params: Any) -> dict[str, float]:
+        """Static per-round wire facts for the metrics registry (see
+        docs/observability.md): bytes one worker sends per round and per
+        neighbor send, the bucket count of the active wire layout, and
+        the dense->wire compression ratio. ``params`` may be shape
+        structs (``jax.eval_shape`` output) — nothing is materialized.
+        """
+        import numpy as np
+
+        wire = self.wire_bytes_per_round(params)
+        sends = max(self._sends_per_round(), 1e-9)
+        sel = params
+        if self.config.path_filter is not None:
+            sel, _ = self._select(params)
+        dense = sum(
+            int(np.prod(x.shape)) * 4 for x in jax.tree.leaves(sel)
+        )
+        plan = self.bucket_plan(params)
+        # one send's payload; gossip_steps multiplies the round total but
+        # not the per-send size, and the ratio is dense vs ONE payload
+        # (the codec's compression), not vs the round's repeat count
+        per_send = wire / sends / max(self.config.gossip_steps, 1)
+        return {
+            "wire_bytes_per_round": float(wire),
+            "wire_bytes_per_neighbor": float(per_send),
+            "gossip_buckets": float(plan.num_buckets) if plan else 0.0,
+            "compression_ratio": float(dense / per_send) if wire else 0.0,
+            "neighbor_sends_per_round": float(sends),
+        }
+
+    def choco_residual(self, state: Any) -> float | None:
+        """Host-side CHOCO tracking residual ``||s - xhat||`` from a
+        gossip state (ChocoState, or an OverlapState carrying one) —
+        the quantity whose growth signals the codec losing track of the
+        params (docs/convergence.md frontier). None for exact mixing.
+        Fetches the state to host; sample it at ``--telemetry-every``
+        cadence, not every round."""
+        choco = getattr(state, "choco", state)
+        if not isinstance(choco, ChocoState):
+            return None
+        sq = 0.0
+        for si, hi in zip(
+            jax.tree.leaves(choco.s), jax.tree.leaves(choco.xhat)
+        ):
+            d = jax.device_get(si) - jax.device_get(hi)
+            sq += float((d.astype("float64") ** 2).sum())
+        return float(sq) ** 0.5

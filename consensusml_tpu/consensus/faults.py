@@ -36,7 +36,41 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["FaultConfig", "draw_alive", "tree_all_finite", "masked_mixing_matrix"]
+__all__ = [
+    "FaultConfig",
+    "draw_alive",
+    "tree_all_finite",
+    "masked_mixing_matrix",
+    "record_fault_metrics",
+]
+
+
+def record_fault_metrics(alive_frac: float) -> None:
+    """Feed one round's alive fraction into the telemetry registry
+    (host-side — the draws themselves happen inside jit, so the training
+    loop reports the fetched ``alive_frac`` metric here).
+
+    Counts ``consensusml_fault_rounds_total`` (rounds where any worker
+    missed the gossip) and ``consensusml_worker_drops_total`` (fractional
+    worker-rounds lost), and gauges the latest alive fraction.
+    """
+    from consensusml_tpu.obs import get_registry
+
+    reg = get_registry()
+    af = float(alive_frac)
+    reg.gauge(
+        "consensusml_alive_frac",
+        "fraction of workers that participated in the last gossip round",
+    ).set(af)
+    if af < 1.0:
+        reg.counter(
+            "consensusml_fault_rounds_total",
+            "gossip rounds in which at least one worker dropped",
+        ).inc()
+        reg.counter(
+            "consensusml_worker_drops_total",
+            "cumulative fraction of worker-rounds lost to faults",
+        ).inc(1.0 - af)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,6 +23,23 @@ import threading
 
 import numpy as np
 
+from consensusml_tpu.obs import get_registry
+
+# host-runtime telemetry (docs/observability.md): how far ahead the C++
+# producer ring runs, and whether consumers exploit buffer reuse
+_BATCHES = get_registry().counter(
+    "consensusml_native_batches_total",
+    "round batches handed out by the native prefetch ring",
+)
+_REUSE_HITS = get_registry().counter(
+    "consensusml_native_reuse_hits_total",
+    "NativeLoader.next(out=...) calls that reused caller buffers",
+)
+_QUEUE_DEPTH = get_registry().gauge(
+    "consensusml_native_queue_depth",
+    "slots the producer ring is ahead of the consumer (sampled at next())",
+)
+
 __all__ = [
     "available",
     "quantize_int8_chunks",
@@ -381,6 +398,13 @@ class NativeLoader:
             ints = _copy(iptr, self._shape_i, np.int32, out and out[1])
         finally:
             self._lib.cml_loader_release(self._h, idx)
+        self._consumed = getattr(self, "_consumed", 0) + 1
+        _BATCHES.inc()
+        if out is not None:
+            _REUSE_HITS.inc()
+        # produced() counts finished slots; the difference to what this
+        # consumer has taken is the ring's current run-ahead
+        _QUEUE_DEPTH.set(max(0, self.produced() - self._consumed))
         return data, ints
 
     def produced(self) -> int:

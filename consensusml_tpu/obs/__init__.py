@@ -1,0 +1,36 @@
+"""Unified telemetry for the gossip stack: spans, metrics, flight data.
+
+Three pillars (see docs/observability.md for the full schema):
+
+- :mod:`~consensusml_tpu.obs.tracer` — host-side nested spans recorded
+  into a bounded ring buffer and exportable as Chrome trace-event JSON
+  (Perfetto-loadable); every span also enters a ``jax.named_scope`` so
+  host spans line up with xprof device timelines.
+- :mod:`~consensusml_tpu.obs.metrics` — counters / gauges / fixed-bucket
+  histograms with a Prometheus textfile exporter and a JSONL sink.
+- :mod:`~consensusml_tpu.obs.flight` — a crash flight recorder that dumps
+  the span ring + last-K metric snapshots to a timestamped JSON file on
+  watchdog timeout, unhandled exception, or SIGTERM.
+
+Hot paths feed the process-wide singletons (``get_tracer()`` /
+``get_registry()``); ``train.py`` surfaces the sinks via
+``--trace-events`` / ``--metrics-prom`` / ``--flight-recorder`` /
+``--telemetry-every``. With no sink configured the tracer stays disabled
+(spans reduce to bare named scopes) and metric updates are dict-cheap, so
+the instrumentation can stay on everywhere.
+"""
+
+from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
+from consensusml_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    DEFAULT_LATENCY_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from consensusml_tpu.obs.tracer import (  # noqa: F401
+    SpanTracer,
+    get_tracer,
+    span,
+)

@@ -1,0 +1,137 @@
+"""Crash flight recorder: dump the last N rounds of evidence on failure.
+
+A hung or dying run's most valuable debugging artifact is what it was
+doing JUST BEFORE: the last rounds' spans (which stage the round was in)
+and the last metric snapshots (was wire traffic normal? was the loss
+finite? was a peer already flagged dead?). The tracer's ring buffer and
+the registry's snapshot ring hold exactly that, bounded; the
+:class:`FlightRecorder` serializes both to a timestamped JSON file when
+one of three triggers fires:
+
+- **watchdog timeout** — ``utils.watchdog.ProgressWatchdog(on_timeout=
+  recorder.dump)``: the dump lands before the hard ``os._exit``, so a
+  wedged collective still leaves evidence (the exact scenario the
+  watchdog exists for);
+- **unhandled exception** — a chained ``sys.excepthook``;
+- **SIGTERM** — a chained signal handler (the launcher's preemption
+  path), which re-raises the previous disposition so the process still
+  terminates.
+
+``install()`` is idempotent per recorder and restores nothing: the hooks
+live for the process, like the crash handlers they are.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+import traceback
+from typing import Any
+
+from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry
+from consensusml_tpu.obs.tracer import SpanTracer, get_tracer
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        out_dir: str,
+        tracer: SpanTracer | None = None,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.out_dir = out_dir
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.registry = registry if registry is not None else get_registry()
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_sigterm = None
+        self.last_dump_path: str | None = None
+
+    # -- the dump ----------------------------------------------------------
+    def dump(self, reason: str, detail: str | None = None) -> str | None:
+        """Write ``flightrec-<utc>-<reason>.json``; returns the path.
+
+        Never raises: a failing dump must not mask the original crash.
+        """
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+            slug = "".join(c if c.isalnum() else "-" for c in reason)[:40]
+            path = os.path.join(
+                self.out_dir, f"flightrec-{stamp}-{slug}.json"
+            )
+            doc: dict[str, Any] = {
+                "reason": reason,
+                "detail": detail,
+                "time_s": time.time(),
+                "pid": os.getpid(),
+                "argv": sys.argv,
+                "spans": self.tracer.events(),
+                "trace_events": self.tracer.trace_events(),
+                "metric_snapshots": self.registry.snapshots(),
+                "metrics_final": self.registry.snapshot(
+                    {"flight_recorder_reason": reason}
+                ),
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            self.last_dump_path = path
+            print(f"flight recorder: dumped {path} ({reason})",
+                  file=sys.stderr, flush=True)
+            return path
+        except Exception as e:  # pragma: no cover - last-resort guard
+            try:
+                print(f"flight recorder: dump failed: {e}",
+                      file=sys.stderr, flush=True)
+            except Exception:
+                pass
+            return None
+
+    # -- triggers ----------------------------------------------------------
+    def install(self, sigterm: bool = True) -> "FlightRecorder":
+        """Chain into sys.excepthook (always) and SIGTERM (when asked and
+        possible — signal handlers only install from the main thread)."""
+        if self._installed:
+            return self
+        self._installed = True
+
+        self._prev_excepthook = sys.excepthook
+
+        def _hook(exc_type, exc, tb):
+            if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+                self.dump(
+                    "unhandled-exception",
+                    detail="".join(
+                        traceback.format_exception(exc_type, exc, tb)
+                    )[-4000:],
+                )
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = _hook
+
+        if sigterm:
+            try:
+                self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+
+                def _on_term(signum, frame):
+                    self.dump("sigterm")
+                    prev = self._prev_sigterm
+                    if callable(prev):
+                        prev(signum, frame)
+                    elif prev != signal.SIG_IGN:
+                        # default disposition: re-raise for a clean kill
+                        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                        os.kill(os.getpid(), signal.SIGTERM)
+
+                signal.signal(signal.SIGTERM, _on_term)
+            except ValueError:
+                # not the main thread — excepthook/watchdog paths still work
+                pass
+        return self

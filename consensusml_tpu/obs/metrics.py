@@ -1,0 +1,282 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+The per-round hot path does DICT-CHEAP work only — a counter increment is
+one float add under a lock, a histogram observe is a bisect into fixed
+buckets. Exporters are pull-style and pay their cost at export time:
+
+- :meth:`MetricsRegistry.to_prometheus` — Prometheus text exposition
+  format, written atomically by :meth:`write_prometheus` (the standard
+  node-exporter *textfile collector* pattern: point
+  ``--collector.textfile.directory`` at the file's directory and the
+  metrics scrape like any other target).
+- :meth:`MetricsRegistry.snapshot` / :meth:`write_jsonl_snapshot` — one
+  JSON object of current values; the registry also retains the last
+  ``snapshot_keep`` snapshots in a ring for the flight recorder.
+
+Metric names follow Prometheus conventions (``consensusml_`` prefix,
+``_total`` on counters, base units — see docs/observability.md for the
+full schema).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+# round latencies span ~1 ms (smoke MLP on CPU) to minutes (first-round
+# XLA compile); log-spaced like prometheus defaults but wider
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+_VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not name or name[0] not in _VALID_FIRST:
+            raise ValueError(f"bad metric name {name!r}")
+        self.name = name
+        self.help = help
+        # RLock, not Lock: the flight recorder's SIGTERM handler runs ON
+        # the main thread and dumps the registry — with a plain lock a
+        # signal landing inside a metric's critical section would
+        # deadlock the handler against the very frame it interrupted
+        self._lock = threading.RLock()
+
+    def expose(self) -> list[str]:
+        raise NotImplementedError
+
+    def value_dict(self) -> Any:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing float (Prometheus ``counter``)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({amount})")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {_fmt(self._value)}"]
+
+    def value_dict(self) -> float:
+        return self._value
+
+
+class Gauge(_Metric):
+    """Point-in-time float (Prometheus ``gauge``)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = math.nan
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, amount: float) -> None:
+        with self._lock:
+            self._value = (0.0 if math.isnan(self._value) else self._value) + amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {_fmt(self._value)}"]
+
+    def value_dict(self) -> float:
+        return self._value
+
+
+class Histogram(_Metric):
+    """Fixed-bucket cumulative histogram (Prometheus ``histogram``).
+
+    Buckets are chosen at registration and never reallocated — an
+    ``observe`` is a bisect + two adds, cheap enough for every round.
+    """
+
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help: str = "",
+        buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+    ):
+        super().__init__(name, help)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.buckets = tuple(bs)
+        self._counts = [0] * (len(bs) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> list[str]:
+        lines = []
+        cum = 0
+        for le, n in zip(self.buckets, self._counts):
+            cum += n
+            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {cum}')
+        cum += self._counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+        lines.append(f"{self.name}_count {self._count}")
+        return lines
+
+    def value_dict(self) -> dict[str, Any]:
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "buckets": {
+                _fmt(le): n for le, n in zip(self.buckets, self._counts)
+            },
+            "inf": self._counts[-1],
+        }
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Get-or-create metric registry with Prometheus / JSONL exporters."""
+
+    def __init__(self, snapshot_keep: int = 64):
+        self._metrics: dict[str, _Metric] = {}
+        # RLock for the same signal-reentrancy reason as _Metric._lock
+        self._lock = threading.RLock()
+        self._snapshots: deque[dict[str, Any]] = deque(maxlen=snapshot_keep)
+
+    def _get(self, cls, name: str, help: str, **kwargs) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- Prometheus exporter ----------------------------------------------
+    def to_prometheus(self) -> str:
+        lines: list[str] = []
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+    def write_prometheus(self, path: str) -> str:
+        """Atomic textfile write (tmp + rename): a scraper never reads a
+        torn file, which is the textfile-collector contract."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+        return path
+
+    # -- JSONL / snapshot sink --------------------------------------------
+    def snapshot(self, extra: dict[str, Any] | None = None) -> dict[str, Any]:
+        """Current values as one JSON-able dict; retained in the
+        last-K ring the flight recorder dumps."""
+        snap: dict[str, Any] = {"time_s": time.time()}
+        if extra:
+            snap.update(extra)
+        snap["metrics"] = {m.name: m.value_dict() for m in self.metrics()}
+        self._snapshots.append(snap)
+        return snap
+
+    def snapshots(self) -> list[dict[str, Any]]:
+        return list(self._snapshots)
+
+    def write_jsonl_snapshot(
+        self, fileobj, extra: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        snap = self.snapshot(extra)
+        fileobj.write(json.dumps(snap) + "\n")
+        fileobj.flush()
+        return snap
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry the instrumented hot paths feed."""
+    return _GLOBAL

@@ -1,0 +1,202 @@
+"""Span tracer: host-side nested spans + device-visible named scopes.
+
+One ``span("gossip.round")`` does two things at once:
+
+- records a HOST span (begin/end wall-clock, thread, nesting depth) into a
+  bounded ring buffer, exportable as Chrome trace-event JSON that Perfetto
+  / ``chrome://tracing`` loads directly;
+- enters a ``jax.named_scope`` with the same name, so when the span body
+  is being TRACED by jit the resulting HLO ops carry the label and the
+  host span lines up with the device timeline in an xprof dump
+  (``train.py --profile-dir`` + ``tools/xprof_summary.py``).
+
+Spans placed inside jitted code (the consensus engine's round functions)
+therefore fire on the host only while the program is being traced —
+typically round 0 — and are pure named scopes afterwards. That is the
+design, not a limitation: steady-state rounds must not pay host work per
+engine stage, while the compile-round trace still shows the full nesting
+(``train.round`` -> ``gossip.round`` -> ``bucket.pack`` -> ...).
+
+The ring buffer is bounded (``capacity`` spans, oldest dropped) so the
+tracer can stay on for a week-long run and still hand the flight recorder
+the LAST N rounds of evidence at crash time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+import contextlib
+
+__all__ = ["SpanTracer", "get_tracer", "span", "null_scope"]
+
+
+def _named_scope(name: str):
+    # lazy jax import: the tracer must stay importable (and cheap) from
+    # host-only code like the native loader before jax is configured
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def null_scope():
+    return contextlib.nullcontext()
+
+
+class SpanTracer:
+    """Bounded ring buffer of completed spans.
+
+    ``enabled=False`` reduces :meth:`span` to the bare ``jax.named_scope``
+    (no host recording, no ring append) — the path a run with no trace
+    sink configured stays on.
+    """
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        self._events: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._tls = threading.local()
+        # RLock: appends and snapshots share it (a snapshot during an
+        # append must not see a mutating deque), and the flight
+        # recorder's signal-handler dump may interrupt an append on the
+        # same thread — reentrancy keeps that from deadlocking
+        self._lock = threading.RLock()
+        self.enabled = enabled
+        # perf_counter gives monotonic span math; the epoch anchor lets a
+        # flight-recorder reader correlate spans with log timestamps
+        self._anchor_perf = time.perf_counter()
+        self._anchor_epoch = time.time()
+
+    # -- recording ---------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        """``with tracer.span("gossip.round", round=3): ...``"""
+        if not self.enabled:
+            with _named_scope(name):
+                yield
+            return
+        depth = getattr(self._tls, "depth", 0)
+        self._tls.depth = depth + 1
+        t0 = time.perf_counter()
+        try:
+            with _named_scope(name):
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._tls.depth = depth
+            ev = {
+                "name": name,
+                "ts_us": (t0 - self._anchor_perf) * 1e6,
+                "dur_us": dur * 1e6,
+                "tid": threading.get_ident(),
+                "depth": depth,
+            }
+            if attrs:
+                ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+            with self._lock:
+                self._events.append(ev)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker event (watchdog beats, fault rounds)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ts_us": (time.perf_counter() - self._anchor_perf) * 1e6,
+            "dur_us": 0.0,
+            "tid": threading.get_ident(),
+            "depth": getattr(self._tls, "depth", 0),
+            "instant": True,
+        }
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export ------------------------------------------------------------
+    def events(self) -> list[dict[str, Any]]:
+        """Snapshot of the ring (oldest first)."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def trace_events(self) -> list[dict[str, Any]]:
+        """Chrome trace-event ("X"/"i" phase) dicts for the current ring."""
+        pid = os.getpid()
+        out: list[dict[str, Any]] = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "args": {"name": "consensusml host"},
+            }
+        ]
+        for ev in self.events():
+            rec: dict[str, Any] = {
+                "name": ev["name"],
+                "pid": pid,
+                "tid": ev["tid"] % 2**31,  # Perfetto wants small tids
+                "ts": round(ev["ts_us"], 3),
+            }
+            if ev.get("instant"):
+                rec["ph"] = "i"
+                rec["s"] = "t"
+            else:
+                rec["ph"] = "X"
+                rec["dur"] = round(ev["dur_us"], 3)
+            if "args" in ev:
+                rec["args"] = ev["args"]
+            out.append(rec)
+        return out
+
+    def write_chrome_trace(self, path: str) -> str:
+        """Dump the ring as a Perfetto-loadable trace-event JSON file."""
+        doc = {
+            "traceEvents": self.trace_events(),
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "anchor_epoch_s": self._anchor_epoch,
+                "source": "consensusml_tpu.obs.tracer",
+            },
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    try:
+        return float(v)
+    except Exception:
+        return str(v)
+
+
+_GLOBAL = SpanTracer(enabled=False)
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide tracer every instrumented module records into.
+
+    Starts DISABLED (pure named scopes, no host recording) so importing
+    instrumented modules costs nothing; ``train.py``/``bench.py`` enable
+    it when a trace or flight-recorder sink is configured.
+    """
+    return _GLOBAL
+
+
+def span(name: str, **attrs):
+    """Module-level shorthand: ``with obs.span("bucket.pack"): ...``"""
+    return _GLOBAL.span(name, **attrs)

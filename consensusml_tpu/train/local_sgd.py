@@ -34,6 +34,7 @@ from consensusml_tpu.consensus import (
     draw_alive,
     tree_all_finite,
 )
+from consensusml_tpu.obs import span as _span
 from consensusml_tpu.train.outer import SlowMoConfig, slowmo_init, slowmo_update
 
 __all__ = [
@@ -190,9 +191,10 @@ def _inner_loop(
         params = optax.apply_updates(params, updates)
         return (params, model_state, opt_state, rng), loss
 
-    (params, model_state, opt_state, rng), losses = jax.lax.scan(
-        body, (params, model_state, opt_state, rng), batch
-    )
+    with _span("train.inner_loop", h=cfg.h):
+        (params, model_state, opt_state, rng), losses = jax.lax.scan(
+            body, (params, model_state, opt_state, rng), batch
+        )
     return params, model_state, opt_state, rng, jnp.mean(losses)
 
 
@@ -365,7 +367,8 @@ def make_collective_train_step(
         outer = state.outer
         if cfg.outer is not None:
             params, outer = slowmo_update(cfg.outer, params, outer)
-        err = engine.consensus_error_collective(params, shard_axes=mm_axes)
+        with _span("train.consensus_error"):
+            err = engine.consensus_error_collective(params, shard_axes=mm_axes)
         new_state = TrainState(
             step=state.step + 1,
             params=params,
@@ -585,7 +588,8 @@ def make_simulated_train_step(
         if cfg.outer is not None:
             # elementwise update — identical math on stacked worker arrays
             params, outer = slowmo_update(cfg.outer, params, outer)
-        err = engine.consensus_error_simulated(params)
+        with _span("train.consensus_error"):
+            err = engine.consensus_error_simulated(params)
         new_state = TrainState(
             step=state.step + 1,
             params=params,

@@ -82,6 +82,17 @@ def resize_state(
     if new_world < 1:
         raise ValueError(f"new_world must be positive, got {new_world}")
 
+    from consensusml_tpu.obs import get_registry
+
+    get_registry().counter(
+        "consensusml_elastic_resizes_total",
+        "elastic world-membership changes applied at resume",
+    ).inc()
+    get_registry().counter(
+        "consensusml_elastic_joined_workers_total",
+        "workers bootstrapped from the consensus mean by elastic grows",
+    ).inc(max(0, new_world - old_world))
+
     if new_world < old_world:
         params = _take(state.params, new_world)
         model_state = _take(state.model_state, new_world)

@@ -1,26 +1,50 @@
 """Metrics logging: stdout lines + machine-readable JSONL.
 
-Covers the reference's metrics/logging subsystem (SURVEY.md §5; mount
-empty). Writes one JSON object per round with wall-clock, loss, and
-consensus-error — the headline pair — plus anything the caller adds.
+Since the obs subsystem landed this is a THIN SHIM over
+:mod:`consensusml_tpu.obs.metrics`: every ``log()`` feeds the numeric
+fields into the process-wide :class:`~consensusml_tpu.obs.MetricsRegistry`
+as ``consensusml_<name>`` gauges (so the Prometheus exporter and the
+flight recorder see the same values the JSONL gets) and keeps the original
+per-round JSONL record + stdout line byte-compatible with the pre-obs
+format. Kept for backward compatibility; new code should talk to the
+registry directly.
+
+``MetricsLogger`` is a context manager — use ``with`` (or
+``contextlib.ExitStack``) so the JSONL handle closes on exception paths
+instead of leaking to interpreter exit.
 """
 
 from __future__ import annotations
 
 import json
+import re
 import sys
 import time
 from typing import Any, IO
 
+from consensusml_tpu.obs import MetricsRegistry, get_registry
+
 __all__ = ["MetricsLogger"]
+
+# caller metric keys are free-form ("plus anything the caller adds") but
+# Prometheus names are not: one bad character would make the textfile
+# collector reject the WHOLE exposition file
+_PROM_SAFE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 class MetricsLogger:
-    def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout, every: int = 1):
+    def __init__(
+        self,
+        jsonl_path: str | None = None,
+        stream: IO = sys.stdout,
+        every: int = 1,
+        registry: MetricsRegistry | None = None,
+    ):
         self._file = open(jsonl_path, "a") if jsonl_path else None
         self._stream = stream
         self._every = max(1, every)
         self._t0 = time.time()
+        self._registry = registry if registry is not None else get_registry()
 
     def log(self, round_idx: int, metrics: dict[str, Any]) -> None:
         record = {
@@ -29,6 +53,10 @@ class MetricsLogger:
             **{k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v)
                for k, v in metrics.items()},
         }
+        for k, v in record.items():
+            if k != "round" and isinstance(v, float):
+                name = _PROM_SAFE.sub("_", f"consensusml_{k}")
+                self._registry.gauge(name).set(v)
         if self._file:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
@@ -43,3 +71,10 @@ class MetricsLogger:
     def close(self) -> None:
         if self._file:
             self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -98,14 +98,25 @@ class RoundTimer:
         self._warmup = warmup
         self._laps: list[float] = []
         self._warmup_laps: list[float] = []
+        # how long the last lap's FENCE blocked: dispatch returned, the
+        # host sat waiting for the device to drain — the stall the
+        # overlap-gossip scheduling is supposed to shrink. Exposed so
+        # telemetry can gauge it (consensusml_round_stall_seconds).
+        self.last_fence_s: float = 0.0
+        self.last_lap_s: float = 0.0
 
     @contextlib.contextmanager
     def lap(self, metrics_fn=None) -> Iterator[None]:
         t0 = time.time()
         yield
         if metrics_fn is not None:
+            t_fence = time.time()
             fence(metrics_fn())
+            self.last_fence_s = time.time() - t_fence
+        else:
+            self.last_fence_s = 0.0
         dt = time.time() - t0
+        self.last_lap_s = dt
         if len(self._warmup_laps) < self._warmup:
             self._warmup_laps.append(dt)
         else:

@@ -42,12 +42,22 @@ class ProgressWatchdog:
         label: str = "train round",
         exit_code: int = 3,
         arm_on_first_beat: bool = True,
+        on_timeout=None,
+        exit_fn=os._exit,
     ):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be positive, got {timeout_s}")
         self.timeout_s = float(timeout_s)
         self.label = label
         self.exit_code = exit_code
+        # ``on_timeout(reason_str)`` runs (exception-guarded) between the
+        # diagnostic and the hard exit — the flight-recorder dump hook:
+        # the last rounds' spans/metrics land on disk even though the
+        # main thread is unrecoverable (obs.flight.FlightRecorder.dump).
+        self.on_timeout = on_timeout
+        # ``exit_fn`` exists for tests: the timeout path is otherwise
+        # untestable in-process (os._exit skips pytest entirely)
+        self._exit_fn = exit_fn
         self._armed = not arm_on_first_beat
         self._last = time.monotonic()
         self._tag: object = None
@@ -88,14 +98,27 @@ class ProgressWatchdog:
                 continue
             stalled = time.monotonic() - self._last
             if stalled > self.timeout_s:
-                print(
-                    f"watchdog: no {self.label} progress for "
+                reason = (
+                    f"no {self.label} progress for "
                     f"{stalled:.0f}s (timeout {self.timeout_s:.0f}s, last "
-                    f"progress: {self._tag}); a peer process has likely "
+                    f"progress: {self._tag})"
+                )
+                print(
+                    f"watchdog: {reason}; a peer process has likely "
                     "died mid-collective — exiting so the launcher can "
                     "reschedule (see consensusml_tpu.utils.watchdog)",
                     file=sys.stderr,
                     flush=True,
                 )
                 sys.stderr.flush()
-                os._exit(self.exit_code)
+                if self.on_timeout is not None:
+                    try:
+                        self.on_timeout(f"watchdog-timeout: {reason}")
+                    except Exception as e:
+                        print(
+                            f"watchdog: on_timeout hook failed: {e}",
+                            file=sys.stderr,
+                            flush=True,
+                        )
+                self._exit_fn(self.exit_code)
+                return  # only reached with a test exit_fn

@@ -1,0 +1,101 @@
+"""Watchdog timeout path + flight-recorder dump (previously untested:
+the only exit was ``os._exit``, unreachable in-process — the injectable
+``exit_fn``/``on_timeout`` hooks exist exactly so this file can cover
+the stall behavior without killing pytest)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from consensusml_tpu.obs import FlightRecorder, MetricsRegistry, SpanTracer
+from consensusml_tpu.utils import ProgressWatchdog
+
+pytestmark = pytest.mark.telemetry
+
+
+def _wait_for(pred, timeout_s=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_stalled_round_dumps_flight_recorder_and_exits(tmp_path):
+    tracer = SpanTracer()
+    registry = MetricsRegistry()
+    # a few rounds of evidence the dump must carry
+    for rnd in range(3):
+        with tracer.span("train.round", round=rnd):
+            pass
+        registry.counter("consensusml_rounds_total").inc()
+        registry.snapshot({"round": rnd})
+    recorder = FlightRecorder(
+        str(tmp_path / "fr"), tracer=tracer, registry=registry
+    )
+    exits = []
+    wd = ProgressWatchdog(
+        timeout_s=0.2,
+        label="test round",
+        on_timeout=recorder.dump,
+        exit_fn=exits.append,
+    ).start()
+    try:
+        wd.beat("round 2")  # arm, then stall: no further beats
+        assert _wait_for(lambda: exits)
+    finally:
+        wd.stop()
+    assert exits == [3]  # the distinct peer-loss exit code
+
+    # the flight-recorder file exists and parses (the acceptance check)
+    files = os.listdir(tmp_path / "fr")
+    assert len(files) == 1 and files[0].startswith("flightrec-")
+    doc = json.load(open(tmp_path / "fr" / files[0]))
+    assert doc["reason"].startswith("watchdog-timeout")
+    assert "test round" in doc["reason"]
+    assert [s["args"]["round"] for s in doc["spans"]] == [0, 1, 2]
+    assert [s["round"] for s in doc["metric_snapshots"]] == [0, 1, 2]
+    assert (
+        doc["metrics_final"]["metrics"]["consensusml_rounds_total"] == 3
+    )
+
+
+def test_beating_watchdog_never_dumps_or_exits(tmp_path):
+    recorder = FlightRecorder(
+        str(tmp_path / "fr"), tracer=SpanTracer(), registry=MetricsRegistry()
+    )
+    exits = []
+    wd = ProgressWatchdog(
+        timeout_s=0.5,
+        on_timeout=recorder.dump,
+        exit_fn=exits.append,
+    ).start()
+    try:
+        for _ in range(8):
+            wd.beat("ok")
+            time.sleep(0.1)
+    finally:
+        wd.stop()
+    time.sleep(0.2)
+    assert exits == []
+    assert not os.path.exists(tmp_path / "fr")
+
+
+def test_failing_on_timeout_hook_still_exits():
+    exits = []
+
+    def bad_hook(reason):
+        raise RuntimeError("dump target vanished")
+
+    wd = ProgressWatchdog(
+        timeout_s=0.2, on_timeout=bad_hook, exit_fn=exits.append
+    ).start()
+    try:
+        wd.beat("armed")
+        assert _wait_for(lambda: exits)
+    finally:
+        wd.stop()
+    assert exits == [3]

@@ -10,17 +10,26 @@ that produced docs/perf.md's tables:
 
 Groups device ops by fused-op family (trailing .N stripped) and reports
 total/share, plus the host-side top-level spans for context.
+
+With ``--host-trace trace.json`` (the Chrome trace-event file
+``train.py --trace-events`` writes — see docs/observability.md) the
+report also includes the obs span tracer's host spans, grouped by name,
+so host rounds and device ops appear in ONE report. The span names match
+the ``jax.named_scope`` labels baked into the HLO, so a span here and an
+op group above with the same prefix are the same region seen from the
+two sides of the dispatch boundary.
 """
 
 from __future__ import annotations
 
+import argparse
 import glob
 import gzip
 import json
 import os
 import re
 import sys
-from collections import Counter
+from collections import Counter, defaultdict
 
 
 def find_trace_json(root: str) -> str | None:
@@ -65,20 +74,95 @@ def summarize(path: str, top: int = 25) -> dict:
     }
 
 
+def summarize_host_trace(path: str) -> list[dict]:
+    """Group an obs trace-event file's host spans by name.
+
+    Accepts both shapes the tracer's ecosystem produces: a dict with a
+    ``traceEvents`` list (``--trace-events`` output) or a bare event
+    list. Instant events count occurrences only.
+    """
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data) if isinstance(data, dict) else data
+    agg: dict[str, dict] = defaultdict(lambda: {"count": 0, "us": 0.0})
+    for e in events:
+        if e.get("ph") not in ("X", "i"):
+            continue
+        a = agg[e["name"]]
+        a["count"] += 1
+        a["us"] += float(e.get("dur", 0.0))
+    return [
+        {
+            "span": name,
+            "count": a["count"],
+            "total_ms": round(a["us"] / 1000, 3),
+            "mean_ms": round(a["us"] / 1000 / a["count"], 3),
+        }
+        for name, a in sorted(
+            agg.items(), key=lambda kv: -kv[1]["us"]
+        )
+    ]
+
+
 def main() -> int:
-    if len(sys.argv) < 2:
-        print(__doc__)
-        return 2
-    root = sys.argv[1]
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("trace_dir", help="xprof trace directory (or a "
+                   "*.trace.json.gz file) from train.py --profile-dir")
+    p.add_argument("--host-trace", default=None, metavar="PATH",
+                   help="Chrome trace-event JSON from train.py "
+                        "--trace-events; its host spans are merged into "
+                        "the report")
+    args = p.parse_args()
+
+    root = args.trace_dir
+    if not os.path.exists(root):
+        print(
+            f"error: trace path {root!r} does not exist — run "
+            "`python train.py ... --profile-dir DIR` first (it dumps the "
+            "xprof trace this tool summarizes)",
+            file=sys.stderr,
+        )
+        return 1
     path = root if root.endswith(".gz") else find_trace_json(root)
     if path is None:
-        print(f"no *.trace.json.gz under {root}", file=sys.stderr)
+        print(
+            f"error: no *.trace.json.gz under {root!r} — the directory "
+            "exists but holds no completed xprof dump (a run killed "
+            "mid-trace leaves none; re-run with --profile-dir)",
+            file=sys.stderr,
+        )
         return 1
     out = summarize(path)
     print(f"trace: {out['trace']}")
     print(f"device op total: {out['device_total_ms']} ms")
     for o in out["ops"]:
         print(f"{o['ms']:10.2f} ms  {100 * o['share']:5.1f}%  {o['op']}")
+
+    if args.host_trace:
+        if not os.path.exists(args.host_trace):
+            print(
+                f"error: --host-trace {args.host_trace!r} does not exist "
+                "— run train.py with --trace-events PATH to produce it",
+                file=sys.stderr,
+            )
+            return 1
+        try:
+            spans = summarize_host_trace(args.host_trace)
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            print(
+                f"error: --host-trace {args.host_trace!r} is not a "
+                f"trace-event JSON file ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"\nhost spans: {args.host_trace}")
+        for s in spans:
+            print(
+                f"{s['total_ms']:10.2f} ms  x{s['count']:<5d} "
+                f"mean {s['mean_ms']:8.3f} ms  {s['span']}"
+            )
     return 0
 
 

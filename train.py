@@ -130,6 +130,25 @@ def parse_args(argv=None):
     p.add_argument("--metrics-out", default=None, help="JSONL metrics path")
     p.add_argument("--profile-dir", default=None,
                    help="dump an xprof trace of rounds 2-3 to this directory")
+    p.add_argument("--trace-events", default=None, metavar="PATH",
+                   help="write the host span ring as Chrome trace-event "
+                        "JSON here at exit (Perfetto / chrome://tracing "
+                        "loadable; spans also enter jax.named_scope so an "
+                        "xprof dump lines up — docs/observability.md)")
+    p.add_argument("--metrics-prom", default=None, metavar="PATH",
+                   help="write the telemetry registry as a Prometheus "
+                        "textfile here (atomically, every --telemetry-every "
+                        "rounds and at exit; point a node-exporter textfile "
+                        "collector at its directory)")
+    p.add_argument("--telemetry-every", type=int, default=10, metavar="N",
+                   help="cadence (rounds) for the heavier telemetry: metric "
+                        "snapshots, Prometheus rewrite, and the CHOCO "
+                        "||s - xhat|| residual fetch (default 10)")
+    p.add_argument("--flight-recorder", default=None, metavar="DIR",
+                   help="enable the crash flight recorder: on watchdog "
+                        "timeout, unhandled exception, or SIGTERM, dump the "
+                        "last rounds' spans + metric snapshots to a "
+                        "timestamped JSON file in DIR")
     p.add_argument("--eval-every", type=int, default=0,
                    help="also run the held-out eval every K rounds during "
                         "training (requires --eval-batches)")
@@ -587,6 +606,27 @@ def main(argv=None) -> int:
         flush=True,
     )
 
+    # ---- telemetry (consensusml_tpu.obs; docs/observability.md) ---------
+    from consensusml_tpu.obs import get_registry, get_tracer
+
+    tracer = get_tracer()
+    registry = get_registry()
+    telemetry_on = bool(
+        args.trace_events or args.metrics_prom or args.flight_recorder
+    )
+    if telemetry_on:
+        # host span recording on; without any sink the tracer stays
+        # disabled and spans are bare jax.named_scopes (dict-cheap path)
+        tracer.enabled = True
+    for k, v in engine.telemetry(param_shapes).items():
+        registry.gauge(f"consensusml_{k}").set(v)
+    recorder = None
+    if args.flight_recorder:
+        from consensusml_tpu.obs import FlightRecorder
+
+        recorder = FlightRecorder(args.flight_recorder).install()
+        print(f"flight recorder armed: {args.flight_recorder}", flush=True)
+
     # --native-wire u8: batches arrive as quantized uint8; the dequant
     # runs INSIDE the jitted step (on device) so the host->device wire
     # stays 1/4 size. The WHOLE feature lives in this block: it wraps
@@ -711,9 +751,45 @@ def main(argv=None) -> int:
         start = replicated_scalar(state.step)
         print(f"resumed from {args.resume} at round {start}", flush=True)
 
+    # ExitStack so the exits fire on exception paths too: the JSONL handle
+    # (MetricsLogger is a context manager now) and the telemetry sink
+    # writes must land even when a round raises mid-run.
+    stack = contextlib.ExitStack()
+    with stack:
+        logger = stack.enter_context(
+            MetricsLogger(args.metrics_out, every=args.log_every)
+        )
+        if args.trace_events:
+            stack.callback(
+                lambda: print(
+                    "trace events: "
+                    f"{tracer.write_chrome_trace(args.trace_events)}",
+                    flush=True,
+                )
+            )
+        if args.metrics_prom:
+            stack.callback(
+                lambda: registry.write_prometheus(args.metrics_prom)
+            )
+        return _train_loop(
+            args, bundle, engine, wire, step, state, start, backend,
+            wmesh if backend == "collective" else None,
+            logger, tracer, registry, recorder, telemetry_on,
+        )
+
+
+def _train_loop(
+    args, bundle, engine, wire, step, state, start, backend, wmesh,
+    logger, tracer, registry, recorder, telemetry_on,
+) -> int:
+    """The round loop, split out of :func:`main` so its sinks can be
+    ExitStack-managed without indenting half the CLI."""
+    import contextlib
+
+    import jax
+
     from consensusml_tpu.utils import RoundTimer, trace as profile_trace
 
-    logger = MetricsLogger(args.metrics_out, every=args.log_every)
     timer = RoundTimer(warmup=1)  # round 0 carries XLA compilation
     metrics = {}
     last_saved = None
@@ -727,6 +803,32 @@ def main(argv=None) -> int:
     # disk writes overlap the next rounds' compute (sync in multiproc —
     # orbax coordinates the processes inside save)
     saver = AsyncSaver()
+
+    m_rounds = registry.counter(
+        "consensusml_rounds_total", "completed training rounds"
+    )
+    m_wire_total = registry.counter(
+        "consensusml_wire_bytes_total",
+        "bytes one worker has put on the gossip wire",
+    )
+    m_latency = registry.histogram(
+        "consensusml_round_latency_seconds",
+        "wall time of one full training round (inner loop + gossip)",
+    )
+
+    def telemetry_tick(rnd, state):
+        """The heavier sampled telemetry (--telemetry-every cadence):
+        CHOCO residual fetch, metric snapshot, Prometheus rewrite."""
+        resid = engine.choco_residual(state.gossip)
+        if resid is not None:
+            registry.gauge(
+                "consensusml_choco_residual",
+                "CHOCO tracking residual ||s - xhat|| (sampled)",
+            ).set(resid)
+        registry.snapshot({"round": rnd})
+        if args.metrics_prom:
+            registry.write_prometheus(args.metrics_prom)
+
     def run_eval(state, rnd):
         # evaluate() caches its jitted step per eval_fn, so periodic
         # calls don't recompile
@@ -769,8 +871,18 @@ def main(argv=None) -> int:
     if args.round_timeout > 0:
         from consensusml_tpu.utils import ProgressWatchdog
 
+        on_timeout = None
+        if recorder is not None:
+            def on_timeout(reason):
+                registry.counter(
+                    "consensusml_watchdog_timeouts_total",
+                    "watchdog round-progress timeouts",
+                ).inc()
+                registry.snapshot({"watchdog_timeout": True})
+                recorder.dump(reason)
+
         watchdog = ProgressWatchdog(
-            args.round_timeout, label="train round"
+            args.round_timeout, label="train round", on_timeout=on_timeout
         ).start()
     batch_shardings = None
     for i, batch in enumerate(batch_source(args.rounds, args.seed, start)):
@@ -784,13 +896,39 @@ def main(argv=None) -> int:
         if args.profile_dir and i == 2:
             profiling = profile_trace(args.profile_dir)
             profiling.__enter__()
-        with timer.lap(metrics_fn=lambda: metrics):
-            state, metrics = step(state, batch)
+        with tracer.span("train.round", round=rnd):
+            with timer.lap(metrics_fn=lambda: metrics):
+                state, metrics = step(state, batch)
         if args.profile_dir and i == 4:
             profiling.__exit__(None, None, None)
             profiling = contextlib.nullcontext()
             print(f"profile trace: {args.profile_dir}", flush=True)
         logger.log(rnd, metrics)  # float() fetches => a real execution fence
+        # per-round registry feed: a few float stores — cheap enough to
+        # stay on unconditionally (docs/observability.md schema)
+        m_rounds.inc()
+        m_wire_total.inc(wire)
+        m_latency.observe(timer.last_lap_s)
+        if "consensus_error" in metrics:
+            registry.gauge(
+                "consensusml_consensus_distance",
+                "post-gossip consensus distance sqrt(mean_i ||x_i - xbar||^2)",
+            ).set(float(metrics["consensus_error"]))
+        registry.gauge(
+            "consensusml_round_stall_seconds",
+            "host wait at the round's execution fence (overlap headroom)",
+        ).set(timer.last_fence_s)
+        if timer.last_lap_s > 0:
+            registry.gauge(
+                "consensusml_inner_steps_per_sec",
+                "local optimizer steps per second per worker",
+            ).set(bundle.cfg.h / timer.last_lap_s)
+        if "alive_frac" in metrics:
+            from consensusml_tpu.consensus import record_fault_metrics
+
+            record_fault_metrics(float(metrics["alive_frac"]))
+        if telemetry_on and (rnd + 1) % max(1, args.telemetry_every) == 0:
+            telemetry_tick(rnd, state)
         if watchdog is not None:
             watchdog.beat(f"round {rnd}")
         if (
@@ -827,7 +965,16 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         saver.wait()
         print(f"checkpoint: {saver.last_path}", flush=True)
-    logger.close()
+    if (
+        telemetry_on
+        and metrics
+        # skip when the loop's own cadence just ticked this round —
+        # a duplicate tick would re-fetch the full CHOCO state at exit
+        and (start + args.rounds) % max(1, args.telemetry_every) != 0
+    ):
+        # final sample so short runs (< --telemetry-every rounds) still
+        # land a snapshot; the ExitStack writes the prom/trace files
+        telemetry_tick(start + args.rounds - 1, state)
     if metrics:
         print(f"timing: {timer.stats().format()}", flush=True)
         print(
