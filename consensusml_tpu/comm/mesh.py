@@ -184,10 +184,27 @@ class WorkerMesh:
 
         if shardings is None:
             shardings = self.stacked_shardings(tree, rules)
+
+        def _placed(x, sharding) -> bool:
+            # a leaf the device prefetcher (or a previous shard_stacked)
+            # already committed with the target sharding is used AS IS —
+            # re-putting it would be the "second transfer" the overlapped
+            # feed exists to avoid
+            return (
+                isinstance(x, _jax.Array)
+                and getattr(x, "sharding", None) == sharding
+            )
+
         if _jax.process_count() == 1:
-            return _jax.tree.map(_jax.device_put, tree, shardings)
+            return _jax.tree.map(
+                lambda x, s: x if _placed(x, s) else _jax.device_put(x, s),
+                tree,
+                shardings,
+            )
 
         def put(x, sharding):
+            if _placed(x, sharding):
+                return x
             if hasattr(x, "dtype") and _jax.dtypes.issubdtype(
                 x.dtype, _jax.dtypes.prng_key
             ):

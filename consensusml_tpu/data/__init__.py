@@ -16,10 +16,17 @@ from consensusml_tpu.data.synthetic import (  # noqa: F401
     round_batches,
 )
 from consensusml_tpu.data.native_pipeline import (  # noqa: F401
+    native_cls_feed,
     native_file_round_batches,
     native_file_token_batches,
     native_lm_round_batches,
     native_round_batches,
+    plan_ring,
+)
+from consensusml_tpu.data.prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    FeedItem,
+    prefetch_to_device,
 )
 from consensusml_tpu.data.files import (  # noqa: F401
     FileClassification,

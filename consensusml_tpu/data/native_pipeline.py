@@ -18,6 +18,8 @@ tests; this path is the throughput path.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from consensusml_tpu.data.synthetic import (
@@ -31,7 +33,35 @@ __all__ = [
     "native_lm_round_batches",
     "native_file_round_batches",
     "native_file_token_batches",
+    "native_cls_feed",
+    "plan_ring",
 ]
+
+
+def plan_ring(
+    samples_per_slot: int,
+    sample_wire_bytes: int,
+    prefetch: int = 2,
+    cpu_count: int | None = None,
+) -> tuple[int, int]:
+    """Size the native ring from the round shape: ``(depth, nthreads)``.
+
+    ``depth``: the device prefetcher holds up to ``prefetch`` staged
+    batches plus one in transfer, each pinning a ring slot until its H2D
+    copy completes — ``prefetch + 2`` keeps at least one slot free for
+    the producers at all times (no fill/consume deadlock, no starvation).
+
+    ``nthreads``: producer work scales with slot bytes (synthesis or
+    gather + optional quantize pass over every sample), so grant roughly
+    one thread per 8 MB of slot payload, within [2, cpus-2] — a 77 MB
+    ImageNet-shaped round gets ~10 threads where the old fixed default
+    of 2 left the ring permanently behind the consumer.
+    """
+    depth = max(2, int(prefetch) + 2)
+    cpus = cpu_count if cpu_count is not None else (os.cpu_count() or 4)
+    slot_mb = samples_per_slot * max(1, sample_wire_bytes) / 1e6
+    nthreads = int(min(max(2, slot_mb // 8 + 1), max(2, cpus - 2)))
+    return depth, nthreads
 
 
 def _ring_yield(loader, rounds, world_size, h, batch, image_shape):
@@ -42,9 +72,9 @@ def _ring_yield(loader, rounds, world_size, h, batch, image_shape):
     Each round gets FRESH host arrays (loader.next() copies out of the
     ring): ``jnp.asarray`` ALIASES numpy memory on the CPU backend and
     may read it asynchronously on TPU, so a reused host buffer would
-    silently rewrite batches the consumer still holds. Callers that can
-    prove their batch lifetimes may manage rotation themselves via
-    ``loader.next(out=...)``."""
+    silently rewrite batches the consumer still holds. The zero-copy
+    path that avoids this copy safely is :func:`_ring_view_yield` +
+    ``DevicePrefetcher`` (slot release deferred to transfer completion)."""
     import jax.numpy as jnp
 
     for _ in range(rounds):
@@ -55,6 +85,40 @@ def _ring_yield(loader, rounds, world_size, h, batch, image_shape):
             ),
             "label": jnp.asarray(ints.reshape(world_size, h, batch)),
         }
+
+
+def _ring_view_yield(loader, rounds, world_size, h, batch, image_shape, depth):
+    """Zero-copy consume loop: yields :class:`~consensusml_tpu.data.
+    prefetch.FeedItem`\\ s whose leaves are numpy VIEWS of the ring slot
+    (the slot is the H2D staging buffer) and whose ``on_done`` releases
+    the slot back to the producers. ``pool=depth`` tells the prefetcher
+    how many slots exist, so it caps its in-flight transfer window below
+    the pool size regardless of what the caller configured.
+
+    MUST be consumed through ``DevicePrefetcher`` (or with manual
+    ``on_done`` calls): without releases the ring deadlocks once all
+    slots are acquired, and a slot's bytes may be rewritten the moment
+    its release fires.
+
+    Loader lifetime: callers must NOT close the loader around this
+    generator — slot memory has to stay alive until the last deferred
+    release fires (an in-flight ``device_put`` reads the slot
+    asynchronously on accelerator backends, so destroy-before-drain is
+    a use-after-free). The release closures hold the loader; after the
+    prefetcher drains them, refcounting finalizes it (``__del__`` →
+    ``close``)."""
+    from consensusml_tpu.data.prefetch import FeedItem
+
+    for _ in range(rounds):
+        idx, data, ints = loader.acquire_view()
+        yield FeedItem(
+            {
+                "image": data.reshape(world_size, h, batch, *image_shape),
+                "label": ints.reshape(world_size, h, batch),
+            },
+            lambda i=idx: loader.release_slot(i),
+            pool=depth,
+        )
 
 
 def native_round_batches(
@@ -70,6 +134,7 @@ def native_round_batches(
     wire: str = "f32",
     qscale: float = 32.0,
     qoff: float = 4.0,
+    views: bool = False,
 ):
     """Yield ``rounds`` stacked ``(W, H, B, *image_shape)`` batches.
 
@@ -78,13 +143,15 @@ def native_round_batches(
     slot sequence is the round number, so resume keeps the exact stream.
     ``wire="u8"`` ships quantized bytes (1/4 the host->device traffic;
     producer threads run the quantize pass) — consumers dequant on device
-    as ``u8 / qscale - qoff``.
+    as ``u8 / qscale - qoff``. ``views=True`` switches to the zero-copy
+    FeedItem stream (see :func:`_ring_view_yield`; DevicePrefetcher
+    consumption required).
     """
     from consensusml_tpu.native import NativeLoader
 
     sample_floats = int(np.prod(dataset.image_shape))
     per_slot = world_size * h * batch
-    with NativeLoader(
+    loader = NativeLoader(
         kind="classification",
         samples_per_slot=per_slot,
         sample_floats=sample_floats,
@@ -99,10 +166,20 @@ def native_round_batches(
         wire=wire,
         qscale=qscale,
         qoff=qoff,
-    ) as loader:
-        yield from _ring_yield(
-            loader, rounds, world_size, h, batch, dataset.image_shape
+    )
+    if views:
+        # no eager close: slot views are read by in-flight async
+        # transfers after this generator exhausts — the release closures
+        # keep the loader alive until the prefetcher drains them, then
+        # refcounting finalizes it (see _ring_view_yield)
+        yield from _ring_view_yield(
+            loader, rounds, world_size, h, batch, dataset.image_shape, depth
         )
+    else:
+        with loader:
+            yield from _ring_yield(
+                loader, rounds, world_size, h, batch, dataset.image_shape
+            )
 
 
 def native_lm_round_batches(
@@ -163,6 +240,7 @@ def native_file_round_batches(
     wire: str = "f32",
     qscale: float = 32.0,
     qoff: float = 4.0,
+    views: bool = False,
 ):
     """File-backed classification batches through the C++ prefetch ring.
 
@@ -171,13 +249,14 @@ def native_file_round_batches(
     so --data-dir training overlaps batch assembly with device compute.
     Deterministic in ``seed``; the sampled indices differ from the Python
     path's numpy draws (documented divergence, as with the procedural
-    kinds).
+    kinds). ``views=True``: zero-copy FeedItem stream (DevicePrefetcher
+    consumption required).
     """
     from consensusml_tpu.native import NativeLoader
 
     sample_floats = int(np.prod(dataset.image_shape))
     per_slot = world_size * h * batch
-    with NativeLoader(
+    loader = NativeLoader(
         kind="file_classification",
         samples_per_slot=per_slot,
         sample_floats=sample_floats,
@@ -192,10 +271,83 @@ def native_file_round_batches(
         wire=wire,
         qscale=qscale,
         qoff=qoff,
-    ) as loader:
-        yield from _ring_yield(
-            loader, rounds, world_size, h, batch, dataset.image_shape
+    )
+    if views:
+        # lifetime contract as in native_round_batches: the prefetcher's
+        # deferred releases finalize the loader, never this generator
+        yield from _ring_view_yield(
+            loader, rounds, world_size, h, batch, dataset.image_shape, depth
         )
+    else:
+        with loader:
+            yield from _ring_yield(
+                loader, rounds, world_size, h, batch, dataset.image_shape
+            )
+
+
+def native_cls_feed(
+    dataset,
+    world_size: int,
+    h: int,
+    batch: int,
+    rounds: int,
+    *,
+    seed: int = 0,
+    start: int = 0,
+    wire: str = "u8",
+    qscale: float = 32.0,
+    qoff: float = 4.0,
+    prefetch: int = 2,
+    depth: int | None = None,
+    nthreads: int | None = None,
+    placement=None,
+    place: bool = True,
+):
+    """The overlapped host→device classification feed, end to end.
+
+    Zero-copy ring views (the slot is the staging buffer) pushed through
+    a :class:`~consensusml_tpu.data.prefetch.DevicePrefetcher`: batch
+    synthesis/gather+quantize run on the C++ producer threads, the H2D
+    transfer for round ``r+1`` overlaps round ``r``'s compute, and slots
+    release the moment their bytes are on device. ``depth``/``nthreads``
+    default to :func:`plan_ring` sized from the round shape.
+
+    ``prefetch <= 0`` disables overlap and falls back to the plain
+    copying iterator — same byte stream (determinism is a function of
+    ``(seed, round)`` only), so the two paths are A/B-comparable.
+
+    Works for both classification sources (``SyntheticClassification``
+    and ``data.files.FileClassification`` — anything with ``images``/
+    ``labels`` tables routes to the file kind, mirroring
+    configs._native_cls_batches).
+    """
+    wire_bytes = 1 if wire == "u8" else 4
+    sample_floats = int(np.prod(dataset.image_shape))
+    plan_depth, plan_threads = plan_ring(
+        world_size * h * batch, sample_floats * wire_bytes, max(prefetch, 1)
+    )
+    depth = plan_depth if depth is None else depth
+    nthreads = plan_threads if nthreads is None else nthreads
+    from consensusml_tpu.data.files import FileClassification
+
+    fn = (
+        native_file_round_batches
+        if isinstance(dataset, FileClassification)
+        else native_round_batches
+    )
+    source = fn(
+        dataset, world_size, h, batch, rounds, seed=seed, depth=depth,
+        nthreads=nthreads, start=start, wire=wire, qscale=qscale, qoff=qoff,
+        views=prefetch > 0,
+    )
+    # the view stream's FeedItem.pool caps the prefetcher's in-flight
+    # window below the ring depth (a shallow explicit depth shrinks the
+    # window instead of deadlocking the ring)
+    from consensusml_tpu.data.prefetch import prefetch_to_device
+
+    return prefetch_to_device(
+        source, prefetch, placement=placement, place=place
+    )
 
 
 def native_file_token_batches(

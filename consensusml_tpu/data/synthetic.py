@@ -22,6 +22,13 @@ __all__ = ["SyntheticClassification", "round_batches", "SyntheticLM", "lm_round_
 class SyntheticClassification:
     """Deterministic synthetic classification dataset, sharded by worker."""
 
+    # the u8-wire quant affine for this data family: prototypes+noise are
+    # ~N(0,1)-scale, so u8 = clip((x + 4) * 32) covers [-4, 4). The ONE
+    # source of truth for every u8 consumer of synthetic images (configs'
+    # native closures, bench's u8 feeds, the perf sweep's dequant step).
+    U8_QSCALE = 32.0
+    U8_QOFF = 4.0
+
     n: int = 8192
     image_shape: tuple[int, ...] = (28, 28, 1)
     classes: int = 10

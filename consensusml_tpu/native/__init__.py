@@ -33,7 +33,8 @@ _BATCHES = get_registry().counter(
 )
 _REUSE_HITS = get_registry().counter(
     "consensusml_native_reuse_hits_total",
-    "NativeLoader.next(out=...) calls that reused caller buffers",
+    "staging-buffer reuses: next(out=...) caller-buffer fills plus "
+    "zero-copy slot releases (release_slot)",
 )
 _QUEUE_DEPTH = get_registry().gauge(
     "consensusml_native_queue_depth",
@@ -223,6 +224,11 @@ class NativeLoader:
     the caller reshapes (see data.native_pipeline). Deterministic: slot
     ``i`` of a loader with seed ``s`` has identical bytes regardless of
     ``nthreads``/``depth``/timing.
+
+    Two consume paths: :meth:`next` copies the slot out (simple, always
+    safe), :meth:`acquire_view`/:meth:`release_slot` exposes the slot's
+    own memory zero-copy — the device-prefetch hot path (the slot IS the
+    H2D staging buffer; see data.prefetch).
     """
 
     def __init__(
@@ -406,6 +412,57 @@ class NativeLoader:
         # consumer has taken is the ring's current run-ahead
         _QUEUE_DEPTH.set(max(0, self.produced() - self._consumed))
         return data, ints
+
+    def acquire_view(self) -> tuple[int, np.ndarray, np.ndarray]:
+        """Zero-copy consume: ``(slot_idx, data_view, ints_view)``.
+
+        The arrays are VIEWS of the ring slot's own memory — the hot
+        path the device prefetcher uses: the slot doubles as the H2D
+        staging buffer, ``jax.device_put`` reads straight out of it, and
+        the per-batch allocation+copy that :meth:`next` pays disappears.
+
+        Contract: the views are valid only until :meth:`release_slot`
+        is called with the returned index, and the caller MUST release
+        every acquired slot or the ring deadlocks once all ``depth``
+        slots are held (the producer threads have nowhere to write).
+        ``DevicePrefetcher`` releases automatically once the transfer
+        out of the slot has completed; consume through it (see
+        data.native_pipeline.native_cls_feed) unless you manage slot
+        lifetimes yourself.
+        """
+        wire_dtype = np.uint8 if self._wire == "u8" else np.float32
+        data_p = _u8p() if self._wire == "u8" else _f32p()
+        iptr = _i32p()
+        acquire = (
+            self._lib.cml_loader_acquire_u8
+            if self._wire == "u8"
+            else self._lib.cml_loader_acquire
+        )
+        idx = acquire(self._h, ctypes.byref(data_p), ctypes.byref(iptr))
+        if idx < 0:
+            raise RuntimeError("loader stopped")
+
+        def _view(ptr, shape, dt):
+            if 0 in shape:  # empty buffer: C++ data() may be NULL
+                return np.empty(shape, dt)
+            arr = np.ctypeslib.as_array(ptr, shape=shape)
+            arr.flags.writeable = False  # views are read-only by contract
+            return arr
+
+        data = _view(data_p, self._shape_f, wire_dtype)
+        ints = _view(iptr, self._shape_i, np.int32)
+        self._consumed = getattr(self, "_consumed", 0) + 1
+        _BATCHES.inc()
+        _QUEUE_DEPTH.set(max(0, self.produced() - self._consumed))
+        return idx, data, ints
+
+    def release_slot(self, idx: int) -> None:
+        """Hand slot ``idx`` (from :meth:`acquire_view`) back to the
+        producer ring. Safe after :meth:`close` (no-op) so deferred
+        release hooks can fire during teardown."""
+        if getattr(self, "_h", None):
+            self._lib.cml_loader_release(self._h, idx)
+            _REUSE_HITS.inc()  # the slot itself is the reused staging buffer
 
     def produced(self) -> int:
         return int(self._lib.cml_loader_produced(self._h))

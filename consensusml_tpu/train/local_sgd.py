@@ -40,6 +40,7 @@ from consensusml_tpu.train.outer import SlowMoConfig, slowmo_init, slowmo_update
 __all__ = [
     "LocalSGDConfig",
     "TrainState",
+    "batch_placement",
     "init_state",
     "init_stacked_state",
     "make_collective_train_step",
@@ -162,6 +163,36 @@ def init_stacked_state(
         rng=jax.vmap(jax.random.fold_in, in_axes=(0, None))(rngs, 1),
         outer=slowmo_init(params) if cfg.outer is not None else None,
     )
+
+
+def batch_placement(backend: str, wmesh: WorkerMesh | None = None):
+    """Where a round batch should live for ``backend``'s train step.
+
+    Hand the result to ``DevicePrefetcher(placement=...)`` so batches
+    are staged exactly where the jitted step consumes them — both step
+    builders accept already-on-device batches as-is (a committed array
+    with the right placement is used in place; only host arrays pay a
+    dispatch-time transfer), so a prefetched batch crosses the host→
+    device boundary exactly once.
+
+    - ``"collective"`` (single-process): the mesh's flat-stacked
+      sharding — leading ``(W, ...)`` axis split over the worker axes,
+      matching the step's ``shard_map`` in_specs, so jit neither
+      reshards nor re-transfers.
+    - ``"simulated"`` (or no mesh): ``None`` — the default device.
+
+    Multi-controller runs return ``None`` too: ``device_put`` cannot
+    target non-addressable shards; the train loop assembles global
+    arrays via ``WorkerMesh.shard_stacked`` instead (which skips leaves
+    that already carry the target sharding).
+    """
+    if (
+        backend == "collective"
+        and wmesh is not None
+        and jax.process_count() == 1
+    ):
+        return wmesh.stacked_sharding()
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -99,12 +99,24 @@ def parse_args(argv=None):
                         "(producer threads run ahead of the device; see "
                         "data.native_pipeline). Sample draws differ from the "
                         "Python loaders' numpy streams by design")
-    p.add_argument("--native-wire", choices=("f32", "u8"), default="f32",
+    p.add_argument("--native-wire", choices=("f32", "u8"), default=None,
                    help="host->device wire format for --native-loader image "
                         "batches: u8 ships quantized bytes (1/4 the "
                         "transfer; file images re-ship their original "
                         "bytes) and the jitted step dequants on device — "
-                        "the measured fastest feed (docs/perf.md)")
+                        "the measured fastest feed (docs/perf.md). Default: "
+                        "u8 for image/classification configs, f32 otherwise "
+                        "(pass --native-wire f32 to force the float wire)")
+    p.add_argument("--prefetch-depth", type=int, default=2, metavar="N",
+                   help="overlapped host->device feed: stage up to N round "
+                        "batches on device ahead of the consumer "
+                        "(DevicePrefetcher; 2 = double buffering, the "
+                        "transfer for round r+1 overlaps round r's "
+                        "compute). 0 disables the overlap (batches "
+                        "transfer synchronously at dispatch, the pre-PR-3 "
+                        "behavior); feed-stall time lands on the "
+                        "consensusml_feed_stall_seconds gauge either way "
+                        "the prefetcher runs (docs/observability.md)")
     p.add_argument("--data-dir", default=None,
                    help="train on real files from this directory (MNIST idx / "
                         "CIFAR-10 binaries / tokens.bin — see data.files); "
@@ -633,7 +645,13 @@ def main(argv=None) -> int:
     # the loss (hence before step construction) AND rebinds
     # bundle.native_batches to the u8-bound source, so the later
     # batch-source selection needs no knowledge of wire modes.
+    # Explicit --native-wire validates loudly; the None default resolves
+    # to u8 whenever the config's native path supports it (the measured
+    # fastest feed, docs/perf.md) and f32 otherwise.
     loss_fn = bundle.loss_fn
+    wire_supported = bundle.native_batches is not None and getattr(
+        bundle.native_batches, "supports_wire", False
+    )
     if args.native_wire == "u8":
         if not args.native_loader:
             print(
@@ -650,13 +668,24 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        if not getattr(bundle.native_batches, "supports_wire", False):
+        if not wire_supported:
             print(
                 f"error: config {bundle.name} has no u8-wire native path "
                 "(image workloads only)",
                 file=sys.stderr,
             )
             return 2
+    native_wire = args.native_wire
+    if native_wire is None:
+        native_wire = "u8" if args.native_loader and wire_supported else "f32"
+    if args.native_loader:
+        why = "explicit" if args.native_wire else (
+            "auto: image config, --native-wire f32 overrides"
+            if native_wire == "u8"
+            else "auto: config has no u8 path"
+        )
+        print(f"native wire: {native_wire} ({why})", flush=True)
+    if native_wire == "u8" and args.native_loader and wire_supported:
         import jax.numpy as jnp
 
         qscale = bundle.native_batches.qscale
@@ -672,9 +701,16 @@ def main(argv=None) -> int:
                 )
             return base_loss(params, model_state, batch, rng)
 
-        bundle.native_batches = lambda rounds, seed, start=0: base_source(
-            rounds, seed, start, wire="u8"
-        )
+        def _u8_batches(rounds, seed, start=0, **kw):
+            return base_source(rounds, seed, start, wire="u8", **kw)
+
+        # the rebound source keeps the capability attributes (configs
+        # RunBundle contract) so the train loop's views/prefetch
+        # selection still sees them
+        for attr in ("supports_wire", "supports_views", "qscale", "qoff"):
+            if hasattr(base_source, attr):
+                setattr(_u8_batches, attr, getattr(base_source, attr))
+        bundle.native_batches = _u8_batches
 
     if backend == "collective":
         from consensusml_tpu.comm import slice_major_devices
@@ -884,76 +920,122 @@ def _train_loop(
         watchdog = ProgressWatchdog(
             args.round_timeout, label="train round", on_timeout=on_timeout
         ).start()
-    batch_shardings = None
-    for i, batch in enumerate(batch_source(args.rounds, args.seed, start)):
-        rnd = start + i
-        if multiproc:
-            # shardings depend only on the (fixed) batch structure —
-            # compute once, reuse every round
-            if batch_shardings is None:
-                batch_shardings = wmesh.stacked_shardings(batch)
-            batch = wmesh.shard_stacked(batch, shardings=batch_shardings)
-        if args.profile_dir and i == 2:
-            profiling = profile_trace(args.profile_dir)
-            profiling.__enter__()
-        with tracer.span("train.round", round=rnd):
-            with timer.lap(metrics_fn=lambda: metrics):
-                state, metrics = step(state, batch)
-        if args.profile_dir and i == 4:
-            profiling.__exit__(None, None, None)
-            profiling = contextlib.nullcontext()
-            print(f"profile trace: {args.profile_dir}", flush=True)
-        logger.log(rnd, metrics)  # float() fetches => a real execution fence
-        # per-round registry feed: a few float stores — cheap enough to
-        # stay on unconditionally (docs/observability.md schema)
-        m_rounds.inc()
-        m_wire_total.inc(wire)
-        m_latency.observe(timer.last_lap_s)
-        if "consensus_error" in metrics:
-            registry.gauge(
-                "consensusml_consensus_distance",
-                "post-gossip consensus distance sqrt(mean_i ||x_i - xbar||^2)",
-            ).set(float(metrics["consensus_error"]))
-        registry.gauge(
-            "consensusml_round_stall_seconds",
-            "host wait at the round's execution fence (overlap headroom)",
-        ).set(timer.last_fence_s)
-        if timer.last_lap_s > 0:
-            registry.gauge(
-                "consensusml_inner_steps_per_sec",
-                "local optimizer steps per second per worker",
-            ).set(bundle.cfg.h / timer.last_lap_s)
-        if "alive_frac" in metrics:
-            from consensusml_tpu.consensus import record_fault_metrics
+    # ---- overlapped host->device feed (data.prefetch) -------------------
+    # The prefetcher stages round r+1's batch on device (non-blocking
+    # device_put, placed where the step consumes it) while round r runs;
+    # the native image path additionally goes zero-copy: ring slots pin
+    # as staging buffers (views=True) and release on transfer completion.
+    # Multi-controller runs keep host batches (global arrays are
+    # assembled below) but still overlap the host-side batch assembly.
+    from consensusml_tpu.data.prefetch import DevicePrefetcher, prefetch_to_device
+    from consensusml_tpu.train import batch_placement
 
-            record_fault_metrics(float(metrics["alive_frac"]))
-        if telemetry_on and (rnd + 1) % max(1, args.telemetry_every) == 0:
-            telemetry_tick(rnd, state)
-        if watchdog is not None:
-            watchdog.beat(f"round {rnd}")
-        if (
-            args.eval_every > 0
-            and (rnd + 1) % args.eval_every == 0
-            # keep the xprof window (rounds 2-3) pure training compute
-            and isinstance(profiling, contextlib.nullcontext)
-            # the end-of-run eval below covers a final-round boundary
-            and rnd + 1 != start + args.rounds
-        ):
+    use_views = (
+        args.prefetch_depth > 0
+        and not multiproc
+        and getattr(batch_source, "supports_views", False)
+    )
+    if use_views:
+        # prefetch sizes the native ring too (each in-flight transfer
+        # pins a slot), so the window is forwarded to the source
+        source = batch_source(
+            args.rounds, args.seed, start,
+            views=True, prefetch=args.prefetch_depth,
+        )
+    else:
+        source = batch_source(args.rounds, args.seed, start)
+    feed = prefetch_to_device(
+        source,
+        args.prefetch_depth,
+        placement=batch_placement(backend, wmesh),
+        place=not multiproc,
+    )
+    batch_shardings = None
+    try:
+        for i, batch in enumerate(feed):
+            rnd = start + i
+            if multiproc:
+                # shardings depend only on the (fixed) batch structure —
+                # compute once, reuse every round
+                if batch_shardings is None:
+                    batch_shardings = wmesh.stacked_shardings(batch)
+                batch = wmesh.shard_stacked(batch, shardings=batch_shardings)
+            if args.profile_dir and i == 2:
+                profiling = profile_trace(args.profile_dir)
+                profiling.__enter__()
+            with tracer.span("train.round", round=rnd):
+                with timer.lap(metrics_fn=lambda: metrics):
+                    state, metrics = step(state, batch)
+            if args.profile_dir and i == 4:
+                profiling.__exit__(None, None, None)
+                profiling = contextlib.nullcontext()
+                print(f"profile trace: {args.profile_dir}", flush=True)
+            logger.log(rnd, metrics)  # float() fetches => a real execution fence
+            # per-round registry feed: a few float stores — cheap enough to
+            # stay on unconditionally (docs/observability.md schema)
+            m_rounds.inc()
+            m_wire_total.inc(wire)
+            m_latency.observe(timer.last_lap_s)
+            if "consensus_error" in metrics:
+                registry.gauge(
+                    "consensusml_consensus_distance",
+                    "post-gossip consensus distance sqrt(mean_i ||x_i - xbar||^2)",
+                ).set(float(metrics["consensus_error"]))
+            registry.gauge(
+                "consensusml_round_stall_seconds",
+                "host wait at the round's execution fence (overlap headroom)",
+            ).set(timer.last_fence_s)
+            if timer.last_lap_s > 0:
+                registry.gauge(
+                    "consensusml_inner_steps_per_sec",
+                    "local optimizer steps per second per worker",
+                ).set(bundle.cfg.h / timer.last_lap_s)
+            if "alive_frac" in metrics:
+                from consensusml_tpu.consensus import record_fault_metrics
+
+                record_fault_metrics(float(metrics["alive_frac"]))
+            if telemetry_on and (rnd + 1) % max(1, args.telemetry_every) == 0:
+                telemetry_tick(rnd, state)
             if watchdog is not None:
-                # eval (incl. its first-call XLA compile) has no per-round
-                # budget: suspend enforcement entirely rather than grant
-                # it one round's allowance, and re-arm when it completes
-                watchdog.pause()
-            run_eval(state, rnd)
-            if watchdog is not None:
-                watchdog.beat(f"eval done @ round {rnd}")
-        if (
-            args.checkpoint_dir
-            and args.checkpoint_every
-            and (rnd + 1) % args.checkpoint_every == 0
-        ):
-            saver.submit(args.checkpoint_dir, state, step=rnd + 1)
-            last_saved = rnd + 1
+                watchdog.beat(f"round {rnd}")
+            if (
+                args.eval_every > 0
+                and (rnd + 1) % args.eval_every == 0
+                # keep the xprof window (rounds 2-3) pure training compute
+                and isinstance(profiling, contextlib.nullcontext)
+                # the end-of-run eval below covers a final-round boundary
+                and rnd + 1 != start + args.rounds
+            ):
+                if watchdog is not None:
+                    # eval (incl. its first-call XLA compile) has no per-round
+                    # budget: suspend enforcement entirely rather than grant
+                    # it one round's allowance, and re-arm when it completes
+                    watchdog.pause()
+                run_eval(state, rnd)
+                if watchdog is not None:
+                    watchdog.beat(f"eval done @ round {rnd}")
+            if (
+                args.checkpoint_dir
+                and args.checkpoint_every
+                and (rnd + 1) % args.checkpoint_every == 0
+            ):
+                saver.submit(args.checkpoint_dir, state, step=rnd + 1)
+                last_saved = rnd + 1
+    finally:
+        # stop the prefetch thread (and close the underlying loader/
+        # generator) on every exit path, including mid-run exceptions
+        close = getattr(feed, "close", None)
+        if close is not None:
+            close()
+    if isinstance(feed, DevicePrefetcher) and feed.batches_out:
+        # the acceptance signal for the overlapped feed: total host wait
+        # for data across the run (~0 when H2D fully hides under compute)
+        print(
+            f"feed: {feed.batches_out} rounds prefetched, stall "
+            f"{feed.stall_seconds_total:.3f}s total "
+            f"({1e3 * feed.last_stall_s:.1f} ms last round)",
+            flush=True,
+        )
     if not isinstance(profiling, contextlib.nullcontext):
         # run ended before round 4: close the trace so the dump is valid
         profiling.__exit__(None, None, None)
