@@ -1,0 +1,44 @@
+"""cml-check: JAX-aware static analysis for the gossip training stack.
+
+Four passes (CLI: ``tools/cml_check.py --all``; docs:
+``docs/static_analysis.md``):
+
+- :mod:`~consensusml_tpu.analysis.host_sync` — AST lint for host/device
+  hazards: syncs and numpy/time/branching inside traced code, plus a
+  baselined inventory of every intentional host sync in the package.
+- :mod:`~consensusml_tpu.analysis.schedule` — statically materializes
+  each topology's per-rank ppermute schedules from the engine's own
+  bucket plans and proves bijectivity, cross-rank agreement and
+  endpoint matching — the static deadlock check.
+- :mod:`~consensusml_tpu.analysis.jaxpr_contracts` — traces each
+  config's train step on CPU and asserts: no host callbacks, no f64
+  promotion, collective counts match the verified schedule, and two
+  consecutive rounds share one compilation.
+- :mod:`~consensusml_tpu.analysis.locks` — lock-discipline race lint
+  over :func:`guarded_by`-annotated classes (the threaded host side:
+  prefetcher, native ring, metrics registry, watchdog).
+
+This ``__init__`` stays import-light (annotations + findings only, no
+jax): runtime modules import :func:`guarded_by` from here at module
+load. The passes are imported as submodules by the CLI and tests.
+"""
+
+from consensusml_tpu.analysis.annotations import guarded_by  # noqa: F401
+from consensusml_tpu.analysis.findings import (  # noqa: F401
+    Baseline,
+    Finding,
+    load_baseline,
+    render_report,
+    split_suppressed,
+    to_json,
+)
+
+__all__ = [
+    "guarded_by",
+    "Finding",
+    "Baseline",
+    "load_baseline",
+    "split_suppressed",
+    "render_report",
+    "to_json",
+]

@@ -1,0 +1,43 @@
+"""Runtime-side annotations the static passes key on.
+
+Kept dependency-free (stdlib only): the instrumented modules
+(``obs.metrics``, ``data.prefetch``, the native loader, the watchdog)
+import :func:`guarded_by` at module load, so this file must never pull
+in jax or anything from the package's runtime layers.
+"""
+
+from __future__ import annotations
+
+__all__ = ["guarded_by"]
+
+
+def guarded_by(lock_attr: str, *attrs: str):
+    """Declare that ``self.<attr>`` (for each attr) may only be read or
+    written while holding ``self.<lock_attr>``::
+
+        @guarded_by("_lock", "_value", "_count")
+        class Counter:
+            ...
+
+    At runtime this only records the contract on the class
+    (``__guarded_by__``: attr -> lock attr, merged across decorators and
+    base classes); enforcement is static — the cml-check lock-discipline
+    pass (:mod:`consensusml_tpu.analysis.locks`) flags any access to an
+    annotated attribute outside a lexical ``with self.<lock_attr>:``
+    block. ``__init__`` is exempt (the object is not shared before
+    construction completes). Intentional exceptions go in
+    ``.cml-check-baseline`` with a comment, not around the convention.
+    """
+    if not isinstance(lock_attr, str) or not lock_attr:
+        raise ValueError("guarded_by needs the lock attribute name first")
+    if not attrs:
+        raise ValueError("guarded_by needs at least one guarded attribute")
+
+    def deco(cls):
+        merged = dict(getattr(cls, "__guarded_by__", {}) or {})
+        for a in attrs:
+            merged[a] = lock_attr
+        cls.__guarded_by__ = merged
+        return cls
+
+    return deco

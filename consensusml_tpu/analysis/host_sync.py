@@ -1,0 +1,494 @@
+"""Host-sync lint: JAX host/device hazards, found statically.
+
+Two families of findings, from one AST walk per file:
+
+**Inside traced code** (functions the linter can prove end up under
+``jit`` / ``shard_map`` / ``lax.scan``-family tracing), the hazards that
+either crash at trace time, silently capture a trace-time constant, or
+fence the device pipeline on every call:
+
+- ``sync-in-traced`` — ``jax.device_get`` / ``block_until_ready``
+- ``numpy-in-traced`` — ``np.asarray`` / ``np.array`` on (potential)
+  tracers: numpy computes at trace time on abstract values and raises —
+  or worse, bakes a constant when fed a concrete side value
+- ``item-in-traced`` — ``.item()`` / ``.tolist()``: concretization, a
+  ``TracerError`` at best
+- ``time-in-traced`` — ``time.time()``-family calls: traced code runs
+  ONCE at trace time; the timestamp becomes a compile-time constant
+- ``branch-on-traced`` — a Python ``if``/``while`` (or conditional
+  expression) whose test references one of the traced function's own
+  parameters as a VALUE. Parameters of a traced function are tracers;
+  branching on one raises ``TracerBoolConversionError``. Static uses are
+  excluded (``x is None``, ``x.attr``, ``isinstance/len/callable/
+  hasattr/getattr/type(x)``, ``x`` in call position), so config-style
+  branching on ``self``/closures never trips this.
+
+**Anywhere in the package** (``host-sync`` rule): every call site of
+``jax.device_get`` / ``block_until_ready``. These are legitimate at
+checkpoint/eval/telemetry boundaries — the point of the rule is that
+every one of them is either deliberate (baselined, with a comment saying
+why) or a regression someone snuck onto a hot path. The checked-in
+``.cml-check-baseline`` is the complete inventory of intentional syncs.
+
+How traced-ness is established (a deliberately conservative heuristic —
+it under-approximates, it does not guess):
+
+1. decorated with ``jit``/``pjit``/``shard_map``/``checkpoint``/
+   ``remat`` (bare, dotted, called, or via ``functools.partial``);
+2. passed in a function position of a tracing caller: ``jax.jit(f)``,
+   ``vmap``/``grad``/``value_and_grad``/``eval_shape``/``make_jaxpr``,
+   ``shard_map(f, ...)``, ``lax.scan``/``while_loop``/``fori_loop``/
+   ``cond``/``switch``/``associative_scan``/``map`` (including lambdas,
+   ``functools.partial(f, ...)`` and ``self.f`` method references, and
+   lists of branches);
+3. lexically nested inside a traced function; or
+4. called (as ``f(...)`` or ``self.f(...)``) from a traced function in
+   the same module, transitively.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from consensusml_tpu.analysis.findings import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+PASS = "host-sync"
+
+# decorators that make the decorated function traced
+_TRACE_DECOS = {"jit", "pjit", "shard_map", "checkpoint", "remat"}
+
+# callee last-segment -> argument positions holding functions to be traced
+_TRACE_CALLERS: dict[str, tuple[int, ...]] = {
+    "jit": (0,),
+    "pjit": (0,),
+    "shard_map": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "eval_shape": (0,),
+    "make_jaxpr": (0,),
+    "named_call": (0,),
+    "scan": (0,),
+    "associative_scan": (0,),
+    "map": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1,),
+}
+
+_SYNC_CALLS = {"device_get", "block_until_ready"}
+_ITEM_CALLS = {"item", "tolist"}
+_TIME_CALLS = {"time", "perf_counter", "monotonic", "process_time"}
+_NUMPY_CALLS = {"asarray", "array"}
+# Name roots whose attribute chains are considered static config, so
+# branch-on-traced never fires through them
+_STATIC_PARAMS = {"self", "cls"}
+_STATIC_TEST_CALLS = {
+    "isinstance", "len", "callable", "hasattr", "getattr", "type", "range",
+}
+
+
+def _last_segment(node: ast.AST) -> str | None:
+    """``jax.lax.scan`` -> ``scan``; ``scan`` -> ``scan``; else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _Scope:
+    def __init__(self, node, qualname: str, parent: "_Scope | None"):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+
+
+def _collect(tree: ast.Module):
+    """One walk: (scopes by node, def-nodes by bare name, numpy/time
+    aliases, call graph edges, traced roots)."""
+    scopes: dict[ast.AST, _Scope] = {}
+    by_name: dict[str, list[ast.AST]] = {}
+    numpy_aliases: set[str] = set()
+    time_aliases: set[str] = set()  # names bound by `from time import time`
+    traced_roots: set[ast.AST] = set()
+    # caller def-node -> set of bare callee names (same module)
+    calls_out: dict[ast.AST, set[str]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # only bare numpy: jnp inside traced code is fine
+                if a.name == "numpy":
+                    numpy_aliases.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name in _TIME_CALLS:
+                        time_aliases.add(a.asname or a.name)
+
+    def visit(node: ast.AST, scope: _Scope | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncNode):
+                name = getattr(child, "name", "<lambda>")
+                qual = f"{scope.qualname}.{name}" if scope else name
+                s = _Scope(child, qual, scope)
+                scopes[child] = s
+                by_name.setdefault(name, []).append(child)
+                if not isinstance(child, ast.Lambda) and _is_traced_by_deco(
+                    child
+                ):
+                    traced_roots.add(child)
+                visit(child, s)
+            elif isinstance(child, ast.ClassDef):
+                qual = (
+                    f"{scope.qualname}.{child.name}" if scope else child.name
+                )
+                visit(child, _Scope(child, qual, scope))
+            else:
+                if isinstance(child, ast.Call) and scope is not None:
+                    callee = _last_segment(child.func)
+                    if callee:
+                        calls_out.setdefault(scope.node, set()).add(callee)
+                if isinstance(child, ast.Call):
+                    for fn_node, fn_name in _trace_position_args(child):
+                        if fn_node is not None:
+                            traced_roots.add(fn_node)
+                        if fn_name is not None:
+                            for d in by_name.get(fn_name, []):
+                                traced_roots.add(d)
+                            # defs seen later still need marking: remember
+                            # the name and resolve after the walk
+                            deferred_names.add(fn_name)
+                visit(child, scope)
+
+    deferred_names: set[str] = set()
+    visit(tree, None)
+    for name in deferred_names:
+        for d in by_name.get(name, []):
+            traced_roots.add(d)
+    return scopes, by_name, numpy_aliases, time_aliases, traced_roots, calls_out
+
+
+def _is_traced_by_deco(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for deco in fn.decorator_list:
+        if (seg := _last_segment(deco)) in _TRACE_DECOS:
+            return True
+        if isinstance(deco, ast.Call):
+            if (seg := _last_segment(deco.func)) in _TRACE_DECOS:
+                return True
+            if _last_segment(deco.func) == "partial" and deco.args:
+                if _last_segment(deco.args[0]) in _TRACE_DECOS:
+                    return True
+    return False
+
+
+def _unwrap_fn_ref(node: ast.AST):
+    """A node in a function position -> (lambda node | None, name | None)."""
+    if isinstance(node, ast.Lambda):
+        return node, None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return None, _last_segment(node)
+    if isinstance(node, ast.Call) and _last_segment(node.func) == "partial":
+        if node.args:
+            return _unwrap_fn_ref(node.args[0])
+    return None, None
+
+
+# callee names that collide with common non-tracing APIs: only honored
+# when dotted through `lax` (jax.lax.map traces; jax.tree.map does not)
+_LAX_ONLY_CALLERS = {"map", "scan"}
+
+
+def _trace_position_args(call: ast.Call):
+    """Yield (lambda_node, bare_name) for every function-position argument
+    of a tracing caller."""
+    callee = _last_segment(call.func)
+    positions = _TRACE_CALLERS.get(callee or "")
+    if not positions:
+        return
+    if callee in _LAX_ONLY_CALLERS:
+        if not (
+            isinstance(call.func, ast.Attribute)
+            and _last_segment(call.func.value) == "lax"
+        ):
+            return
+    for pos in positions:
+        if pos >= len(call.args):
+            continue
+        arg = call.args[pos]
+        targets = (
+            arg.elts if isinstance(arg, (ast.List, ast.Tuple)) else [arg]
+        )
+        for t in targets:
+            yield _unwrap_fn_ref(t)
+
+
+def _propagate(scopes, by_name, traced_roots, calls_out) -> set[ast.AST]:
+    """Traced closure: nesting + same-module call graph, to fixpoint."""
+    traced: set[ast.AST] = set(traced_roots)
+    changed = True
+    while changed:
+        changed = False
+        for node, scope in scopes.items():
+            if node in traced:
+                continue
+            # nested inside a traced function
+            p = scope.parent
+            while p is not None:
+                if isinstance(p.node, _FuncNode) and p.node in traced:
+                    traced.add(node)
+                    changed = True
+                    break
+                p = p.parent
+        for caller in list(traced):
+            for name in calls_out.get(caller, ()):
+                for d in by_name.get(name, []):
+                    if d not in traced:
+                        traced.add(d)
+                        changed = True
+    return traced
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names - _STATIC_PARAMS
+
+
+class _TestScan(ast.NodeVisitor):
+    """Does a branch test reference a traced param as a VALUE?"""
+
+    def __init__(self, params: set[str]):
+        self.params = params
+        self.hits: list[str] = []
+
+    def visit_Attribute(self, node: ast.Attribute):
+        # x.attr: shape/dtype/config access — static, don't descend into
+        # the root name (but do scan subscripts etc. inside)
+        if isinstance(node.value, ast.Name):
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if _last_segment(node.func) in _STATIC_TEST_CALLS:
+            return  # len(x)/isinstance(x, ...)/... are static
+        # the function being called is not a value use of a param tracer
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    def visit_Compare(self, node: ast.Compare):
+        # `x is None` / `x is not None` is a static presence check
+        if (
+            len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+            and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in [node.left, *node.comparators]
+            )
+        ):
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load) and node.id in self.params:
+            self.hits.append(node.id)
+
+
+def _scan_traced_body(
+    fn, qualname: str, path: str, numpy_aliases, time_aliases
+) -> Iterable[Finding]:
+    """Hazards in one traced function's own body (nested defs excluded —
+    they are traced themselves and scanned separately)."""
+    params = _param_names(fn)
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncNode):
+                continue
+            yield child
+            yield from walk(child)
+
+    mk = lambda rule, detail, msg, line: Finding(
+        PASS, rule, path, qualname, detail, msg, line
+    )
+    for node in walk(ast.Module(body=list(body), type_ignores=[])):
+        if isinstance(node, ast.Call):
+            seg = _last_segment(node.func)
+            if seg in _SYNC_CALLS:
+                yield mk(
+                    "sync-in-traced", seg,
+                    f"{seg}() inside traced code fences the device "
+                    "pipeline (or crashes on a tracer); hoist it out of "
+                    "the jitted region",
+                    node.lineno,
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ITEM_CALLS
+                and not node.args
+            ):
+                yield mk(
+                    "item-in-traced", node.func.attr,
+                    f".{node.func.attr}() concretizes a tracer "
+                    "(TracerError at trace time); keep the value on "
+                    "device",
+                    node.lineno,
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in numpy_aliases
+                and node.func.attr in _NUMPY_CALLS
+            ):
+                yield mk(
+                    "numpy-in-traced", f"np.{node.func.attr}",
+                    "numpy call inside traced code computes at trace "
+                    "time (TracerError on a tracer, or a baked-in "
+                    "constant); use jnp",
+                    node.lineno,
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time"
+                and node.func.attr in _TIME_CALLS
+            ) or (
+                isinstance(node.func, ast.Name)
+                and node.func.id in time_aliases
+            ):
+                detail = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else node.func.id
+                )
+                yield mk(
+                    "time-in-traced", f"time.{detail}",
+                    "wall-clock read inside traced code becomes a "
+                    "compile-time constant (traced once, replayed "
+                    "forever); time on the host side",
+                    node.lineno,
+                )
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            scan = _TestScan(params)
+            scan.visit(node.test)
+            for hit in sorted(set(scan.hits)):
+                yield mk(
+                    "branch-on-traced", hit,
+                    f"Python branch on parameter {hit!r} of a traced "
+                    "function (tracers have no truth value); use "
+                    "jnp.where / lax.cond, or baseline if the argument "
+                    "is statically known here",
+                    node.lineno,
+                )
+
+
+def lint_source(src: str, path: str) -> list[Finding]:
+    """Lint one file's source. ``path`` is the repo-relative name used in
+    finding ids."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                PASS, "syntax-error", path, "", "parse",
+                f"file does not parse: {e}", e.lineno or 0,
+            )
+        ]
+    (
+        scopes, by_name, numpy_aliases, time_aliases, roots, calls_out
+    ) = _collect(tree)
+    traced = _propagate(scopes, by_name, roots, calls_out)
+
+    findings: list[Finding] = []
+    for node in traced:
+        scope = scopes.get(node)
+        if scope is None:
+            continue
+        findings.extend(
+            _scan_traced_body(
+                node, scope.qualname, path, numpy_aliases, time_aliases
+            )
+        )
+
+    # package-wide sync inventory (rule "host-sync"): every device_get /
+    # block_until_ready call OUTSIDE traced code — deliberate ones are
+    # baselined, new ones are presumed hot-path regressions
+    traced_ranges = [
+        (n.lineno, max(n.lineno, getattr(n, "end_lineno", n.lineno) or 0))
+        for n in traced
+    ]
+
+    def in_traced(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in traced_ranges)
+
+    class _SyncScan(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: list[str] = []
+
+        def _named(self, node):
+            self.stack.append(getattr(node, "name", "<lambda>"))
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _named
+
+        def visit_Call(self, node: ast.Call):
+            seg = _last_segment(node.func)
+            if seg in _SYNC_CALLS and not in_traced(node.lineno):
+                findings.append(
+                    Finding(
+                        PASS, "host-sync", path, ".".join(self.stack), seg,
+                        f"host sync {seg}() — fine at checkpoint/eval/"
+                        "telemetry boundaries, a regression on a hot "
+                        "path; fix it or baseline it with a comment",
+                        node.lineno,
+                    )
+                )
+            self.generic_visit(node)
+
+    _SyncScan().visit(tree)
+    return findings
+
+
+def lint_file(path: str, repo_root: str) -> list[Finding]:
+    rel = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p, repo_root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn), repo_root)
+                    )
+    return findings

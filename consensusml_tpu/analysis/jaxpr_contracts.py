@@ -1,0 +1,318 @@
+"""Jaxpr contract checks: invariants of the traced train program.
+
+The gossip stack's perf story rests on properties of the COMPILED round
+program that no unit test of the math can see: the round must not call
+back into the host (a callback serializes the device pipeline every
+round), must not silently promote to f64 (4x wire + HBM on a path sized
+in f32), must issue exactly the collectives the schedule verifier
+proved, and must hit the jit cache on every round after the first (a
+signature that drifts between consecutive rounds recompiles every
+round — minutes per round at pod scale, the classic "why is round 2 as
+slow as round 1" regression).
+
+For each config in :mod:`consensusml_tpu.configs` (smoke scale, CPU):
+
+- ``host-callback`` — no callback/debug primitives anywhere in the
+  train-step jaxpr (checked recursively through scan/cond/pjit bodies);
+- ``f64-promotion`` — no float64/complex128 intermediate anywhere;
+- ``collective-count`` — the gossip round, traced per-worker under
+  ``shard_map`` on the config's topology, contains exactly as many
+  ``ppermute`` equations as the schedule materializer predicts from the
+  topology + bucket plan (and none at all for psum topologies). This
+  ties the PROVEN schedule to the TRACED program: if the engine ever
+  issues a collective the verifier did not model, this contract fails
+  rather than the verifier silently passing;
+- ``recompile`` — tracing the train step with the output shapes of
+  round r as the input of round r+1 yields a byte-identical canonical
+  jaxpr: two consecutive rounds share one compilation. Dtype drift
+  (e.g. a weak-type f32 scalar hardening), shape drift, or a
+  config-dependent branch on the round counter all fail this.
+
+Everything traces abstractly (``jax.make_jaxpr`` / ``jax.eval_shape``):
+no parameters are materialized, no program executes, no TPU is needed.
+The train-step contracts run on the simulated backend (identical round
+semantics, cross-validated by tests); the collective-count contract
+traces the collective engine itself under ``shard_map`` on the CPU
+mesh.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+from consensusml_tpu.analysis.findings import Finding
+
+__all__ = ["check_config", "check_all_configs", "count_primitives"]
+
+PASS = "jaxpr"
+
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "debug_print",
+}
+_BAD_DTYPES = {"float64", "complex128"}
+
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in v if isinstance(v, (list, tuple)) else [v]:
+                if hasattr(sub, "eqns"):
+                    yield from _iter_eqns(sub)
+                elif hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub.jaxpr)
+
+
+def count_primitives(jaxpr) -> dict[str, int]:
+    """Recursive primitive histogram of a (closed) jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    counts: dict[str, int] = {}
+    for eqn in _iter_eqns(jaxpr):
+        counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+    return counts
+
+
+def _shard_map_fn():
+    import jax
+
+    try:
+        return jax.shard_map
+    except AttributeError:  # jax < 0.5 keeps shard_map under experimental
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def _canonical_hash(closed_jaxpr) -> str:
+    """Hash of the jaxpr's canonical printed form. Var names in jax's
+    printer are assigned in traversal order, so two traces of the same
+    program print identically — and any structural difference (extra
+    op, dtype change, different constant) changes the text."""
+    text = closed_jaxpr.pretty_print() if hasattr(
+        closed_jaxpr, "pretty_print"
+    ) else str(closed_jaxpr)
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _shape_only(tree: Any):
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+
+
+def _stacked_state_and_batch(bundle):
+    """Abstract stacked TrainState + one concrete round batch (smoke
+    data is procedural and tiny; the state is never materialized)."""
+    import jax
+
+    from consensusml_tpu.train import init_stacked_state
+
+    state = jax.eval_shape(
+        lambda rng: init_stacked_state(
+            bundle.cfg, bundle.init_params, rng, bundle.world_size
+        ),
+        jax.random.key(0),
+    )
+    batch = next(iter(bundle.batches(1, 0)))
+    return state, _shape_only(batch)
+
+
+def _check_step_jaxpr(name: str, bundle) -> list[Finding]:
+    import jax
+
+    from consensusml_tpu.train import make_simulated_train_step
+
+    findings: list[Finding] = []
+    mk = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "train_step", detail, msg
+    )
+    step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
+    state, batch = _stacked_state_and_batch(bundle)
+    closed = jax.make_jaxpr(step)(state, batch)
+
+    counts = count_primitives(closed)
+    for prim in sorted(set(counts) & _CALLBACK_PRIMS):
+        findings.append(
+            mk(
+                "host-callback", prim,
+                f"train step traces a host callback ({prim} x"
+                f"{counts[prim]}): every round would fence the device "
+                "pipeline on the host",
+            )
+        )
+    bad = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in _BAD_DTYPES:
+                bad.add((eqn.primitive.name, dt))
+    for prim, dt in sorted(bad):
+        findings.append(
+            mk(
+                "f64-promotion", f"{prim}:{dt}",
+                f"train step computes in {dt} (via {prim}): doubles "
+                "wire and HBM on a path budgeted in f32 — find the "
+                "promoting op (python float op on a traced value, "
+                "np.float64 constant, ...)",
+            )
+        )
+
+    # recompile contract: round r's OUTPUT shapes, fed back as round
+    # r+1's input, must retrace to the identical program
+    out_state_shapes, _metrics = jax.eval_shape(step, state, batch)
+    h1 = _canonical_hash(closed)
+    h2 = _canonical_hash(jax.make_jaxpr(step)(out_state_shapes, batch))
+    if h1 != h2:
+        findings.append(
+            mk(
+                "recompile", "signature-hash",
+                "round r+1 (fed round r's output state) traces to a "
+                "DIFFERENT program than round r — the jit cache misses "
+                "every round; diff the two jaxprs for the drifting "
+                "dtype/shape/weak-type",
+            )
+        )
+    # ... and the state must be shape-stable outright, or the donated
+    # buffers cannot be reused
+    in_flat = jax.tree.leaves(_shape_only(state))
+    out_flat = jax.tree.leaves(out_state_shapes)
+    drift = [
+        (a.shape, a.dtype, b.shape, b.dtype)
+        for a, b in zip(in_flat, out_flat)
+        if a.shape != b.shape or a.dtype != b.dtype
+    ]
+    if len(in_flat) != len(out_flat) or drift:
+        findings.append(
+            mk(
+                "recompile", "state-drift",
+                f"TrainState changes structure across a round "
+                f"({len(in_flat)} -> {len(out_flat)} leaves, "
+                f"{len(drift)} leaf shape/dtype changes): donation and "
+                "the jit cache both break",
+            )
+        )
+    return findings
+
+
+def _check_collective_count(name: str, bundle) -> list[Finding]:
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from consensusml_tpu.analysis import schedule as sched
+    from consensusml_tpu.train.local_sgd import _gossiped
+
+    findings: list[Finding] = []
+    engine = bundle.cfg.engine()
+    cfg = engine.config
+    topo = engine.topology
+    mk = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "gossip_round", detail, msg
+    )
+    if (
+        cfg.push_sum
+        or cfg.overlap
+        or cfg.faults is not None
+        or cfg.codec_warmup_rounds > 0
+        or cfg.codec_refresh_every > 0
+        or topo.is_time_varying
+    ):
+        # cond/switch trace BOTH wire layouts into one jaxpr; a static
+        # per-round count is not defined there
+        return findings
+    if len(jax.devices()) < topo.world_size:
+        return [
+            mk(
+                "collective-count", "no-mesh",
+                f"cannot trace: {topo.world_size} workers but only "
+                f"{len(jax.devices())} devices "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count)",
+            )
+        ]
+
+    from consensusml_tpu.comm import WorkerMesh
+
+    # per-worker gossiped-tree shapes (params + model_state)
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    if isinstance(probe, tuple) and len(probe) == 2:
+        params, model_state = probe
+    else:
+        params, model_state = probe, {}
+    tree = _gossiped(params, model_state)
+    world = topo.world_size
+    stacked = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((world,) + tuple(x.shape), x.dtype),
+        tree,
+    )
+    wmesh = WorkerMesh.create(topo, platform="cpu")
+
+    def round_fn(t):
+        st = engine.init_state(t)
+        out, _ = engine.round_collective(t, st, step=np.int32(0))
+        return out
+
+    f = _shard_map_fn()(
+        round_fn,
+        mesh=wmesh.mesh,
+        in_specs=P(*topo.axis_names),
+        out_specs=P(*topo.axis_names),
+    )
+    counts = count_primitives(jax.make_jaxpr(f)(stacked))
+    traced = counts.get("ppermute", 0)
+    predicted = sum(
+        1
+        for op in sched.materialize_schedules(engine, tree)[0]
+        if op.kind == "ppermute"
+    )
+    if traced != predicted:
+        findings.append(
+            mk(
+                "collective-count", "ppermute",
+                f"gossip round traces {traced} ppermutes but the "
+                f"verified schedule models {predicted} — the engine "
+                "issues collectives the schedule verifier never "
+                "checked (or the wire layout regressed); update "
+                "analysis/schedule.py alongside the engine",
+            )
+        )
+    if topo.uses_psum and traced != 0:
+        findings.append(
+            mk(
+                "collective-count", "psum-topology-ppermute",
+                f"dense (psum) topology traces {traced} ppermutes; the "
+                "dense wire must stay a single reduction",
+            )
+        )
+    return findings
+
+
+def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
+    """All jaxpr contracts for one config."""
+    from consensusml_tpu import configs
+
+    bundle = configs.build(name, scale=scale)
+    findings = _check_step_jaxpr(name, bundle)
+    findings.extend(_check_collective_count(name, bundle))
+    return findings
+
+
+def check_all_configs(*, scale: str = "smoke") -> list[Finding]:
+    from consensusml_tpu import configs
+
+    findings: list[Finding] = []
+    for name in configs.names():
+        try:
+            findings.extend(check_config(name, scale=scale))
+        except Exception as e:  # a config that cannot trace IS a finding
+            findings.append(
+                Finding(
+                    PASS, "trace-error", f"configs:{name}", "", type(e).__name__,
+                    f"tracing the {name} train step failed: {e}",
+                )
+            )
+    return findings

@@ -1,0 +1,230 @@
+"""Lock-discipline race lint for ``@guarded_by``-annotated classes.
+
+The threaded host side of the stack (device prefetcher, native ring
+loader, metrics registry, watchdog) shares mutable state between a
+training thread, producer threads, signal handlers and teardown hooks.
+An unguarded mutation there does not crash — it corrupts counters or
+races a handle free. This pass makes the locking contract *checkable*:
+
+    @guarded_by("_lock", "_value", "_count")
+    class Counter:
+        def inc(self):
+            with self._lock:
+                self._value += 1      # ok
+        def peek(self):
+            return self._value        # finding: unguarded-read
+
+Rules:
+
+- ``unguarded-read`` / ``unguarded-write`` — ``self.<attr>`` access for
+  an annotated attr outside a lexical ``with self.<lock>:`` block.
+- ``__init__`` is exempt: the object is not shared before construction
+  completes. (``__del__`` is NOT exempt — finalizers run concurrently
+  with everything.)
+- A ``with self.<lock>:`` anywhere up the lexical statement chain
+  satisfies the contract; multi-item ``with`` statements count each
+  item. ``self.<lock>.acquire()`` does NOT count — the pass cannot see
+  the matching release, and the codebase convention is ``with``.
+- Functions nested inside a method are analyzed with an EMPTY lock set:
+  a closure may escape the lock scope it was created in (handed to a
+  thread/callback), so holding the lock at definition time proves
+  nothing about call time. Baseline the finding if the closure provably
+  never escapes.
+
+The decorator itself lives in
+:mod:`consensusml_tpu.analysis.annotations` and is a pure metadata
+no-op at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from consensusml_tpu.analysis.findings import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+PASS = "locks"
+
+
+def _guard_map_from_class(cls: ast.ClassDef) -> dict[str, str]:
+    """attr -> lock attr, from ``@guarded_by("lock", "a", "b")``
+    decorators (string literals only — the annotation is a static
+    contract, computed lock names defeat the point)."""
+    gm: dict[str, str] = {}
+    for deco in cls.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        name = (
+            deco.func.attr
+            if isinstance(deco.func, ast.Attribute)
+            else getattr(deco.func, "id", None)
+        )
+        if name != "guarded_by" or not deco.args:
+            continue
+        vals = [
+            a.value
+            for a in deco.args
+            if isinstance(a, ast.Constant) and isinstance(a.value, str)
+        ]
+        if len(vals) >= 2:
+            lock, *attrs = vals
+            for a in attrs:
+                gm[a] = lock
+    return gm
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodScan:
+    def __init__(self, guard: dict[str, str], cls_name: str, path: str):
+        self.guard = guard
+        self.cls_name = cls_name
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def scan(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        if fn.name == "__init__":
+            return
+        self._walk_stmts(fn.body, frozenset(), f"{self.cls_name}.{fn.name}")
+
+    def _walk_stmts(self, stmts, held: frozenset[str], qual: str) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # closures escape lock scope: analyze with no locks held
+                self._walk_stmts(
+                    st.body, frozenset(), f"{qual}.<locals>.{st.name}"
+                )
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                now = set(held)
+                for item in st.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None:
+                        now.add(attr)
+                    self._scan_expr(item.context_expr, held, qual)
+                    if item.optional_vars is not None:
+                        self._scan_expr(item.optional_vars, held, qual)
+                self._walk_stmts(st.body, frozenset(now), qual)
+                continue
+            # compound statements: scan their own expressions with the
+            # current lock set, then their bodies
+            for field in ("test", "iter", "value", "exc", "cause", "msg"):
+                sub = getattr(st, field, None)
+                if isinstance(sub, ast.AST):
+                    self._scan_expr(sub, held, qual)
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                # the read side of `self.x += 1` is covered by the write
+                # finding; st.value was scanned via the field loop above
+                targets = (
+                    st.targets
+                    if isinstance(st, ast.Assign)
+                    else [st.target]
+                )
+                for t in targets:
+                    self._scan_target(t, held, qual)
+            for body_field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, body_field, None)
+                if isinstance(sub, list):
+                    self._walk_stmts(sub, held, qual)
+            for h in getattr(st, "handlers", []) or []:
+                self._walk_stmts(h.body, held, qual)
+
+    def _flag(self, attr: str, lock: str, write: bool, line: int, qual: str):
+        rule = "unguarded-write" if write else "unguarded-read"
+        verb = "write to" if write else "read of"
+        self.findings.append(
+            Finding(
+                PASS, rule, self.path, qual, attr,
+                f"{verb} self.{attr} outside `with self.{lock}:` "
+                f"(declared guarded_by({lock!r}) on {self.cls_name})",
+                line,
+            )
+        )
+
+    def _scan_target(self, node: ast.AST, held: frozenset[str], qual: str):
+        attr = _self_attr(node)
+        if attr is not None:
+            lock = self.guard.get(attr)
+            if lock is not None and lock not in held:
+                self._flag(attr, lock, True, node.lineno, qual)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan_target(child, held, qual)
+
+    def _scan_expr(self, node: ast.AST, held: frozenset[str], qual: str):
+        # a lambda is a closure like a nested def: it may escape the
+        # lock scope it was written under (thread target, callback), so
+        # its body is analyzed with NO locks held — and must be pruned
+        # from the enclosing walk, not just skipped as a node
+        if isinstance(node, ast.Lambda):
+            self._scan_expr(
+                node.body, frozenset(), f"{qual}.<locals>.<lambda>"
+            )
+            return
+        attr = _self_attr(node)
+        if attr is not None:
+            lock = self.guard.get(attr)
+            if lock is not None and lock not in held:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                self._flag(attr, lock, write, node.lineno, qual)
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(child, held, qual)
+
+
+def lint_source(src: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                PASS, "syntax-error", path, "", "parse",
+                f"file does not parse: {e}", e.lineno or 0,
+            )
+        ]
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guard = _guard_map_from_class(node)
+        if not guard:
+            continue
+        scan = _MethodScan(guard, node.name, path)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan.scan(item)
+        findings.extend(scan.findings)
+    return findings
+
+
+def lint_file(path: str, repo_root: str) -> list[Finding]:
+    rel = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p, repo_root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn), repo_root)
+                    )
+    return findings

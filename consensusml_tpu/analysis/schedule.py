@@ -1,0 +1,425 @@
+"""Collective-schedule verifier: prove the gossip wire cannot deadlock.
+
+A ``ppermute`` is a *joint* collective: every rank on the mesh axis must
+enter the same program point with the same permutation, payload shape
+and dtype, or the mesh wedges — the exact failure the watchdog can only
+bound, not prevent, and the one failure class no simulated-comm test can
+produce (the simulated backend multiplies by the mixing matrix; it never
+issues a collective at all). This pass proves the property STATICALLY:
+
+1. **materialize** the per-rank schedule — the ordered list of
+   collective ops one gossip round issues on each rank — from the same
+   code that builds the real round: the topology's shifts, the engine's
+   :meth:`~consensusml_tpu.consensus.engine.ConsensusEngine.bucket_plan`
+   (so bucket coalescing, codec alignment padding and per-leaf fallback
+   are the production layout, not a re-implementation), and the codec's
+   payload structure via ``jax.eval_shape`` (nothing is materialized,
+   no collective runs);
+2. **verify** over all ranks:
+   - ``perm-not-bijective`` — every permutation is a bijection on the
+     axis (each rank sends exactly once and is received from exactly
+     once; a lossy perm silently drops a contribution and breaks the
+     doubly-stochastic mean);
+   - ``deadlock-op-count`` — all ranks issue the same number of
+     collectives per round (a rank-dependent count means someone waits
+     forever on a collective nobody else entered);
+   - ``deadlock-op-mismatch`` — at each schedule position, kind / axis /
+     payload shape / dtype agree across ranks;
+   - ``deadlock-endpoint-mismatch`` — at each position, if rank ``r``
+     sends to ``d``, then rank ``d`` expects to receive from ``r`` with
+     the same payload (pairwise send/recv consistency — the static form
+     of "both endpoints post matching transfers").
+
+Rank-asymmetric schedules cannot arise from a stock
+:class:`~consensusml_tpu.topology.Topology` (one shift list for all
+ranks) — which is exactly what this pass proves, and keeps proved when
+someone adds a topology whose shifts are built per-rank: a topology (or
+test fixture) may expose ``rank_shifts(rank) -> Sequence[Shift]`` and
+the materializer honors it, so a genuinely asymmetric schedule is
+REPORTED as a deadlock instead of discovered on a pod.
+
+Push-sum and fault-masked rounds add flag/mass exchanges this
+materializer does not model yet; engines with ``push_sum=True`` are
+rejected loudly rather than verified incompletely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+from consensusml_tpu.analysis.findings import Finding
+
+__all__ = [
+    "RankOp",
+    "materialize_schedules",
+    "verify_schedules",
+    "verify_engine",
+    "builtin_topologies",
+    "run_builtin",
+]
+
+PASS = "schedule"
+
+
+@dataclasses.dataclass(frozen=True)
+class RankOp:
+    """One collective op as ONE rank experiences it."""
+
+    kind: str  # "ppermute" | "psum"
+    axis: str  # mesh axis name
+    tag: str  # which round stage issued it (for readable reports)
+    shape: tuple[int, ...]
+    dtype: str
+    send_to: int | None = None  # global rank (None for psum)
+    recv_from: int | None = None
+
+    def sig(self) -> tuple:
+        """The part every rank must agree on."""
+        return (self.kind, self.axis, self.shape, self.dtype)
+
+
+def _rank_shifts(topology, rank: int):
+    """The shift list rank ``rank`` executes — ``topology.rank_shifts``
+    when present (asymmetric fixtures / future per-rank graphs), else
+    the shared shift list every stock topology has."""
+    fn = getattr(topology, "rank_shifts", None)
+    if fn is not None:
+        return tuple(fn(rank))
+    return topology.shifts
+
+
+def _shift_endpoints(topology, shift, rank: int) -> tuple[int, int]:
+    """(send_to, recv_from) for ``rank`` under one cyclic shift.
+
+    ``ppermute`` perm ``[(s, (s+offset) % n)]`` along the shift's axis:
+    source ``s`` SENDS to ``s+offset``; a rank RECEIVES from the rank
+    ``offset`` behind it. Multi-axis meshes move along one axis with the
+    other coordinates fixed (matching the named-axis collective).
+    """
+    coords = list(topology.coords(rank))
+    n = topology.mesh_shape[shift.axis]
+    dst = list(coords)
+    dst[shift.axis] = (coords[shift.axis] + shift.offset) % n
+    src = list(coords)
+    src[shift.axis] = (coords[shift.axis] - shift.offset) % n
+    return topology.rank(dst), topology.rank(src)
+
+
+def _codec_payload(comp, shape: tuple[int, ...]) -> list[tuple[tuple[int, ...], str]]:
+    """The compressed payload leaves one buffer of ``shape`` ships, via
+    ``compress_tree`` under ``jax.eval_shape`` — so the schedule ships
+    exactly what the real round's ``ppermute_shift_tree(q, ...)`` ships,
+    without materializing anything."""
+    import jax
+    import jax.numpy as jnp
+
+    spec = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+    q = jax.eval_shape(lambda x: comp.compress_tree(x), spec)
+    return [
+        (tuple(leaf.shape), jnp.dtype(leaf.dtype).name)
+        for leaf in jax.tree.leaves(q)
+    ]
+
+
+def _as_struct_tree(spec):
+    """``[(shape, dtype), ...]`` -> a flat pytree of shape structs;
+    pytrees of ``ShapeDtypeStruct``/arrays pass through unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(spec, (list, tuple)) and all(
+        isinstance(x, tuple)
+        and len(x) == 2
+        and isinstance(x[0], (tuple, list))
+        for x in spec
+    ):
+        return [
+            jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d)) for s, d in spec
+        ]
+    return spec
+
+
+def _wire_buffers(engine, tree) -> list[tuple[str, list[tuple[tuple[int, ...], str]]]]:
+    """``(tag, payload_leaves)`` for every buffer ONE consensus iteration
+    moves per shift, in the engine's issue order. Mirrors
+    ``_phase_collective``: path-filtered leaves drop out entirely,
+    ``compress_filter``-excluded leaves ("auto": the model_state subtree)
+    mix exactly alongside the CHOCO buffers, and bucketing follows the
+    engine's own :meth:`_dense_plan` / :meth:`_codec_plan` layouts."""
+    import jax
+    import jax.numpy as jnp
+
+    dense = lambda leaves, tag: [
+        (f"{tag}{i}", [(tuple(x.shape), jnp.dtype(x.dtype).name)])
+        for i, x in enumerate(leaves)
+    ]
+    dense_buckets = lambda plan, tag: [
+        (f"{tag}{i}", [((b.total,), jnp.dtype(b.dtype).name)])
+        for i, b in enumerate(plan.buckets)
+    ]
+    comp = engine.config.compressor
+    if comp is None:
+        sel = tree
+        if engine.config.path_filter is not None:
+            sel, _ = engine._select(tree)
+        leaves = jax.tree.leaves(sel)
+        if engine.bucketed and leaves:
+            return dense_buckets(engine._dense_plan(leaves), "bucket")
+        return dense(leaves, "leaf")
+    ctree, exact_leaves, _rest, _rebuild = engine._partition(tree)
+    cleaves = jax.tree.leaves(ctree)
+    out: list[tuple[str, list[tuple[tuple[int, ...], str]]]] = []
+    if exact_leaves:
+        if engine.bucketed:
+            out += dense_buckets(
+                engine._dense_plan(exact_leaves), "exact-bucket"
+            )
+        else:
+            out += dense(exact_leaves, "exact-leaf")
+    if engine.bucketed:
+        plan = engine._codec_plan(cleaves)
+        for i, b in enumerate(plan.buckets):
+            out.append((f"bucket{i}", _codec_payload(comp, (b.total,))))
+    else:
+        for i, x in enumerate(cleaves):
+            out.append((f"leaf{i}", _codec_payload(comp, tuple(x.shape))))
+    return out
+
+
+def materialize_schedules(engine, spec, *, phase=None) -> list[list[RankOp]]:
+    """Per-rank collective schedules for one steady-state gossip round.
+
+    ``spec`` — the gossiped tree's PER-WORKER shapes: either a pytree of
+    ``jax.ShapeDtypeStruct`` (real param trees, so ``path_filter`` /
+    ``compress_filter`` see real paths) or a flat list of ``(shape,
+    dtype)`` pairs. ``phase`` — one phase of a time-varying topology
+    (defaults to the engine's topology; callers iterate phases).
+    Returns ``schedules[rank] = [RankOp, ...]`` in the engine's issue
+    order: per consensus iteration, per shift, per buffer, per payload
+    leaf. Warmup/refresh rounds (``lax.cond`` over two wire layouts) are
+    transients; this is the steady-state schedule.
+    """
+    if engine.config.push_sum:
+        raise NotImplementedError(
+            "push-sum rounds add mass/flag exchanges this materializer "
+            "does not model; verify push-sum wires separately"
+        )
+    topo = phase if phase is not None else engine.topology
+    world = topo.world_size
+    buffers = _wire_buffers(engine, _as_struct_tree(spec))
+    n_iter = engine.config.gossip_steps
+
+    schedules: list[list[RankOp]] = []
+    for rank in range(world):
+        ops: list[RankOp] = []
+        for _ in range(n_iter):
+            if topo.uses_psum:
+                for tag, payloads in buffers:
+                    # dense lowers to pmean over the (decoded) buffer —
+                    # one joint reduction per buffer, not per payload leaf
+                    shape, dtype = payloads[0]
+                    ops.append(
+                        RankOp(
+                            kind="psum",
+                            axis="+".join(topo.axis_names),
+                            tag=tag,
+                            shape=shape,
+                            dtype=dtype,
+                        )
+                    )
+                continue
+            for shift in _rank_shifts(topo, rank):
+                send_to, recv_from = _shift_endpoints(topo, shift, rank)
+                for tag, payloads in buffers:
+                    for pshape, pdtype in payloads:
+                        ops.append(
+                            RankOp(
+                                kind="ppermute",
+                                axis=topo.axis_names[shift.axis],
+                                tag=tag,
+                                shape=pshape,
+                                dtype=pdtype,
+                                send_to=send_to,
+                                recv_from=recv_from,
+                            )
+                        )
+        schedules.append(ops)
+    return schedules
+
+
+def verify_schedules(
+    schedules: list[list[RankOp]], *, source: str, topology=None
+) -> list[Finding]:
+    """Check the cross-rank agreement rules; see the module docstring."""
+    findings: list[Finding] = []
+    world = len(schedules)
+    mk = lambda rule, detail, msg: Finding(
+        PASS, rule, source, "", detail, msg
+    )
+
+    counts = {len(ops) for ops in schedules}
+    if len(counts) > 1:
+        per_rank = ", ".join(
+            f"r{r}:{len(ops)}" for r, ops in enumerate(schedules)
+        )
+        findings.append(
+            mk(
+                "deadlock-op-count", "collective-count",
+                f"ranks issue different collective counts per round "
+                f"({per_rank}) — the mesh deadlocks at the first "
+                "position where a rank has no matching collective",
+            )
+        )
+        return findings  # positional checks are meaningless past this
+
+    n_ops = counts.pop() if counts else 0
+    for i in range(n_ops):
+        sigs = {ops[i].sig() for ops in schedules}
+        if len(sigs) > 1:
+            op0 = schedules[0][i]
+            findings.append(
+                mk(
+                    "deadlock-op-mismatch", f"pos{i}",
+                    f"collective #{i} ({op0.tag}) differs across ranks: "
+                    f"{sorted(sigs)} — ranks enter different collectives "
+                    "at the same program point",
+                )
+            )
+            continue
+        op0 = schedules[0][i]
+        if op0.kind != "ppermute":
+            continue
+        # pairwise endpoint consistency: r sends to d  <=>  d receives
+        # from r, with the (already position-uniform) payload
+        for r in range(world):
+            op = schedules[r][i]
+            d = op.send_to
+            peer = schedules[d][i]
+            if peer.recv_from != r:
+                findings.append(
+                    mk(
+                        "deadlock-endpoint-mismatch",
+                        f"pos{i}:r{r}->r{d}",
+                        f"collective #{i} ({op.tag}): rank {r} sends to "
+                        f"rank {d}, but rank {d} expects to receive from "
+                        f"rank {peer.recv_from} — both sides wait on a "
+                        "transfer the other never posts",
+                    )
+                )
+        # bijectivity of the implied permutation
+        sends = [ops[i].send_to for ops in schedules]
+        recvs = [ops[i].recv_from for ops in schedules]
+        if sorted(sends) != list(range(world)) or sorted(recvs) != list(
+            range(world)
+        ):
+            findings.append(
+                mk(
+                    "perm-not-bijective", f"pos{i}",
+                    f"collective #{i} ({op0.tag}): the send permutation "
+                    f"{sends} is not a bijection on {world} ranks — a "
+                    "rank's contribution is dropped or duplicated, "
+                    "breaking the doubly-stochastic mean (and ppermute "
+                    "fills unaddressed ranks with zeros silently)",
+                )
+            )
+    return findings
+
+
+def verify_engine(
+    engine, leaves_spec: Sequence[tuple[tuple[int, ...], Any]], *,
+    source: str,
+) -> list[Finding]:
+    """Materialize + verify every phase of the engine's topology."""
+    topo = engine.topology
+    phases = topo.phases if topo.is_time_varying else [None]
+    findings: list[Finding] = []
+    for pi, phase in enumerate(phases):
+        src = source if phase is None else f"{source}:phase{pi}"
+        schedules = materialize_schedules(engine, leaves_spec, phase=phase)
+        findings.extend(
+            verify_schedules(schedules, source=src, topology=phase or topo)
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# repo harness: every shipped topology x wire layout
+# ---------------------------------------------------------------------------
+
+
+def builtin_topologies(world: int = 8) -> dict[str, Any]:
+    """Every topology family ``topology/topologies.py`` ships, at a
+    representative size (plus the degenerate size-2 merged-edge cases
+    that historically hide bugs)."""
+    from consensusml_tpu.topology import (
+        DenseTopology,
+        ExponentialTopology,
+        HierarchicalTopology,
+        OnePeerExponentialTopology,
+        RingTopology,
+        TorusTopology,
+    )
+
+    return {
+        f"ring{world}": RingTopology(world),
+        "ring2": RingTopology(2),
+        "torus4x2": TorusTopology(4, 2),
+        "torus2x2": TorusTopology(2, 2),
+        f"dense{world}": DenseTopology(world),
+        f"exp{world}": ExponentialTopology(world),
+        f"onepeer-exp{world}": OnePeerExponentialTopology(world),
+        "hier2x4": HierarchicalTopology(slices=2, inner=4),
+    }
+
+
+def _default_leaves() -> list[tuple[tuple[int, ...], str]]:
+    """A mixed tree: interleaved dtypes, a leaf bigger than the small
+    bucket cap, odd sizes that need codec alignment padding."""
+    return [
+        ((256, 64), "float32"),
+        ((64,), "float32"),
+        ((128, 32), "bfloat16"),
+        ((7,), "float32"),
+        ((4096, 16), "float32"),
+        ((32, 32), "bfloat16"),
+    ]
+
+
+def run_builtin(
+    bucket_bytes_options: Sequence[int | None] = (None, 4 * 2**20, 64 * 1024),
+    world: int = 8,
+) -> list[Finding]:
+    """The CLI pass: verify exact and compressed engines over every
+    builtin topology and wire layout. ``bucket_bytes=None`` is the
+    per-leaf wire; the small option forces multi-bucket plans."""
+    from consensusml_tpu.compress import topk_int8_compressor
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+
+    leaves = _default_leaves()
+    findings: list[Finding] = []
+    comp = topk_int8_compressor(ratio=0.1, chunk=128, impl="jnp")
+    for name, topo in builtin_topologies(world).items():
+        for bb in bucket_bytes_options:
+            bb_tag = "perleaf" if bb is None else f"bb{bb}"
+            for comp_tag, compressor in (("exact", None), ("choco", comp)):
+                if compressor is not None and topo.is_time_varying:
+                    # CHOCO tracking across phases is exercised by the
+                    # engine tests; the wire schedule per phase is what
+                    # matters here and the exact engine covers it
+                    continue
+                engine = ConsensusEngine(
+                    GossipConfig(
+                        topology=topo,
+                        compressor=compressor,
+                        gamma=0.5 if compressor else 1.0,
+                        bucket_bytes=bb,
+                    )
+                )
+                findings.extend(
+                    verify_engine(
+                        engine, leaves,
+                        source=f"schedule:{name}:{bb_tag}:{comp_tag}",
+                    )
+                )
+    return findings

@@ -1294,10 +1294,16 @@ class ConsensusEngine:
         choco = getattr(state, "choco", state)
         if not isinstance(choco, ChocoState):
             return None
+        # ONE batched fetch of both trees: per-leaf device_get pairs
+        # serialized 2x#leaves transfers on the telemetry path
+        # (cml-check host-sync:host-sync:consensusml_tpu/consensus/
+        # engine.py:ConsensusEngine.choco_residual:device_get); the
+        # remaining single sync is this metric's documented cost
+        s_host, hat_host = jax.device_get(
+            (jax.tree.leaves(choco.s), jax.tree.leaves(choco.xhat))
+        )
         sq = 0.0
-        for si, hi in zip(
-            jax.tree.leaves(choco.s), jax.tree.leaves(choco.xhat)
-        ):
-            d = jax.device_get(si) - jax.device_get(hi)
-            sq += float((d.astype("float64") ** 2).sum())
+        for si, hi in zip(s_host, hat_host):
+            d = si.astype("float64") - hi.astype("float64")
+            sq += float((d ** 2).sum())
         return float(sq) ** 0.5

@@ -59,6 +59,7 @@ from typing import Any, Callable, Iterable, Iterator, NamedTuple
 
 import numpy as np
 
+from consensusml_tpu.analysis import guarded_by
 from consensusml_tpu.obs import get_registry
 
 __all__ = ["FeedItem", "DevicePrefetcher", "prefetch_to_device"]
@@ -108,6 +109,10 @@ class _Stop(Exception):
     """Internal: consumer closed while the producer was blocked."""
 
 
+@guarded_by(
+    "_lock", "_closed", "_exhausted", "_error",
+    "stall_seconds_total", "last_stall_s", "batches_out",
+)
 class DevicePrefetcher:
     """Stage the next round-batches on device while the current round runs.
 
@@ -157,6 +162,10 @@ class DevicePrefetcher:
         self._place = place
         self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
+        # shared between the consumer thread, the producer thread and
+        # whatever thread GC runs __del__ on — see @guarded_by above;
+        # enforced by the cml-check lock-discipline pass
+        self._lock = threading.Lock()
         self._error: BaseException | None = None
         self._closed = False
         self._exhausted = False
@@ -259,14 +268,16 @@ class DevicePrefetcher:
         except _Stop:
             pass
         except BaseException as e:  # surfaced to the consumer
-            self._error = e
+            with self._lock:
+                self._error = e
         finally:
             try:
                 while pending:
                     self._drain_one(pending)
             except BaseException as e:
-                if self._error is None:
-                    self._error = e
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
             try:
                 self._enqueue(None)  # end-of-stream sentinel
             except _Stop:
@@ -278,40 +289,57 @@ class DevicePrefetcher:
         return self
 
     def __next__(self) -> Any:
-        if self._exhausted:
-            raise StopIteration
+        with self._lock:
+            if self._exhausted:
+                raise StopIteration
         _INFLIGHT.set(self._queue.qsize())
         t0 = time.perf_counter()
         item = self._queue.get()
         wait = time.perf_counter() - t0
         if item is None:
-            self._exhausted = True
+            with self._lock:
+                self._exhausted = True
             self.close()
-            if self._error is not None:
-                raise self._error
+            with self._lock:
+                err = self._error
+            if err is not None:
+                raise err
             raise StopIteration
-        self.last_stall_s = wait
-        self.stall_seconds_total += wait
-        self.batches_out += 1
+        with self._lock:
+            self.last_stall_s = wait
+            self.stall_seconds_total += wait
+            self.batches_out += 1
         _STALL.set(wait)
         _STALL_TOTAL.inc(wait)
         _BATCHES_OUT.inc()
         return item
 
     def close(self) -> None:
-        """Stop the background thread and close the source. Idempotent."""
-        if self._closed:
-            return
-        self._closed = True
-        # a next() after close() must see StopIteration, not block on a
-        # queue no producer will ever feed again
-        self._exhausted = True
+        """Stop the background thread and close the source. Idempotent
+        — and safe against the consumer and a GC-driven ``__del__``
+        racing into it from two threads (the check-and-set is atomic)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            # a next() after close() must see StopIteration, not block
+            # on a queue no producer will ever feed again
+            self._exhausted = True
         self._stop.set()
         # unblock a producer stuck in queue.put by draining
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
+            pass
+        # ... and a CONSUMER blocked in __next__'s queue.get(): the
+        # drain above may have swallowed the producer's end-of-stream
+        # sentinel (and the producer, once stopped, never re-posts it),
+        # so re-post one best-effort — a waiter wakes to StopIteration
+        # instead of blocking forever on a dead queue
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
             pass
         self._thread.join(timeout=30)
         if self._thread.is_alive():

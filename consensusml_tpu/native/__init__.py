@@ -23,6 +23,7 @@ import threading
 
 import numpy as np
 
+from consensusml_tpu.analysis import guarded_by
 from consensusml_tpu.obs import get_registry
 
 # host-runtime telemetry (docs/observability.md): how far ahead the C++
@@ -217,6 +218,7 @@ def topk_chunks(chunks, k: int) -> tuple[np.ndarray, np.ndarray]:
     return vals, idx
 
 
+@guarded_by("_lock", "_h", "_consumed")
 class NativeLoader:
     """Threaded prefetching batch pipeline over the native ring buffer.
 
@@ -229,6 +231,18 @@ class NativeLoader:
     safe), :meth:`acquire_view`/:meth:`release_slot` exposes the slot's
     own memory zero-copy — the device-prefetch hot path (the slot IS the
     H2D staging buffer; see data.prefetch).
+
+    Thread safety: the zero-copy path hands ``release_slot`` to the
+    device prefetcher's BACKGROUND thread (``FeedItem.on_done``) while
+    the consumer thread acquires and teardown closes — so the handle
+    ``_h`` and the ``_consumed`` counter only move under ``_lock``
+    (cml-check lock-discipline pass). The blocking C++ ``acquire`` runs
+    OUTSIDE the lock (holding it there would let a blocked consumer
+    starve the producer's ``release``); acquire-vs-destroy stays the
+    C++ side's contract, as before — ``close()`` wakes blocked
+    consumers with "loader stopped". The lock closes the Python-side
+    use-after-free: a deferred ``release_slot`` can no longer observe a
+    non-None handle that ``close()`` frees mid-call.
     """
 
     def __init__(
@@ -259,6 +273,10 @@ class NativeLoader:
         qscale: float = 32.0,
         qoff: float = 4.0,
     ):
+        # first: __del__ -> close() must find the lock even when the
+        # rest of __init__ raises
+        self._lock = threading.Lock()
+        self._consumed = 0
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_load_failed}")
@@ -310,7 +328,7 @@ class NativeLoader:
                     "samples_per_slot, and the table is large enough for "
                     f"{world} workers: n_items={n_items})"
                 )
-            self._check_wire(fb)
+            self._check_wire(self._h, fb)
             return
         proto_p = None
         succ_p = None
@@ -332,19 +350,35 @@ class NativeLoader:
         )
         if not self._h:
             raise RuntimeError("cml_loader_create failed (bad arguments)")
-        self._check_wire(fb)
+        self._check_wire(self._h, fb)
 
-    def _check_wire(self, fb: int) -> None:
+    def _check_wire(self, h, fb: int) -> None:
         """Attach-time invariant: the library's wire mode for this handle
         matches what this wrapper will read (guards a stale .so whose
         create ignored the float_bytes argument)."""
-        got = int(self._lib.cml_loader_float_bytes(self._h))
+        got = int(self._lib.cml_loader_float_bytes(h))
         if got != fb:
             raise RuntimeError(
                 f"native loader wire mismatch: library reports "
                 f"float_bytes={got}, wrapper expected {fb} — rebuild "
                 "native/ (make -C native)"
             )
+
+    def _handle(self):
+        """The live C++ handle, read under the lock; raises after
+        close() (or on a loader whose __init__ never finished). Blocking
+        C calls take the returned value so they run lock-free (see the
+        class docstring)."""
+        with self._lock:
+            h = getattr(self, "_h", None)
+        if not h:
+            raise RuntimeError("loader closed")
+        return h
+
+    def _count_consumed(self) -> int:
+        with self._lock:
+            self._consumed += 1
+            return self._consumed
 
     def next(self, out=None) -> tuple[np.ndarray, np.ndarray]:
         """Blocking: the next slot's (floats-or-u8, ints) arrays.
@@ -378,6 +412,7 @@ class NativeLoader:
                         f"shape {shape} dtype {np.dtype(dtype).name}, got "
                         f"shape {tuple(arr.shape)} dtype {arr.dtype.name}"
                     )
+        h = self._handle()
         data_p = _u8p() if self._wire == "u8" else _f32p()
         iptr = _i32p()
         acquire = (
@@ -385,7 +420,7 @@ class NativeLoader:
             if self._wire == "u8"
             else self._lib.cml_loader_acquire
         )
-        idx = acquire(self._h, ctypes.byref(data_p), ctypes.byref(iptr))
+        idx = acquire(h, ctypes.byref(data_p), ctypes.byref(iptr))
         if idx < 0:
             raise RuntimeError("loader stopped")
         dtype = wire_dtype
@@ -403,14 +438,14 @@ class NativeLoader:
             data = _copy(data_p, self._shape_f, dtype, out and out[0])
             ints = _copy(iptr, self._shape_i, np.int32, out and out[1])
         finally:
-            self._lib.cml_loader_release(self._h, idx)
-        self._consumed = getattr(self, "_consumed", 0) + 1
+            self._lib.cml_loader_release(h, idx)
+        consumed = self._count_consumed()
         _BATCHES.inc()
         if out is not None:
             _REUSE_HITS.inc()
         # produced() counts finished slots; the difference to what this
         # consumer has taken is the ring's current run-ahead
-        _QUEUE_DEPTH.set(max(0, self.produced() - self._consumed))
+        _QUEUE_DEPTH.set(max(0, self.produced() - consumed))
         return data, ints
 
     def acquire_view(self) -> tuple[int, np.ndarray, np.ndarray]:
@@ -431,6 +466,7 @@ class NativeLoader:
         lifetimes yourself.
         """
         wire_dtype = np.uint8 if self._wire == "u8" else np.float32
+        h = self._handle()
         data_p = _u8p() if self._wire == "u8" else _f32p()
         iptr = _i32p()
         acquire = (
@@ -438,7 +474,7 @@ class NativeLoader:
             if self._wire == "u8"
             else self._lib.cml_loader_acquire
         )
-        idx = acquire(self._h, ctypes.byref(data_p), ctypes.byref(iptr))
+        idx = acquire(h, ctypes.byref(data_p), ctypes.byref(iptr))
         if idx < 0:
             raise RuntimeError("loader stopped")
 
@@ -451,26 +487,36 @@ class NativeLoader:
 
         data = _view(data_p, self._shape_f, wire_dtype)
         ints = _view(iptr, self._shape_i, np.int32)
-        self._consumed = getattr(self, "_consumed", 0) + 1
+        consumed = self._count_consumed()
         _BATCHES.inc()
-        _QUEUE_DEPTH.set(max(0, self.produced() - self._consumed))
+        _QUEUE_DEPTH.set(max(0, self.produced() - consumed))
         return idx, data, ints
 
     def release_slot(self, idx: int) -> None:
         """Hand slot ``idx`` (from :meth:`acquire_view`) back to the
         producer ring. Safe after :meth:`close` (no-op) so deferred
-        release hooks can fire during teardown."""
-        if getattr(self, "_h", None):
-            self._lib.cml_loader_release(self._h, idx)
-            _REUSE_HITS.inc()  # the slot itself is the reused staging buffer
+        release hooks can fire during teardown — the release runs under
+        the handle lock, so it can never race ``close()`` freeing the
+        ring out from under it (the prefetcher's background thread fires
+        these)."""
+        with self._lock:
+            if self._h:
+                self._lib.cml_loader_release(self._h, idx)
+            else:
+                return
+        _REUSE_HITS.inc()  # the slot itself is the reused staging buffer
 
     def produced(self) -> int:
-        return int(self._lib.cml_loader_produced(self._h))
+        return int(self._lib.cml_loader_produced(self._handle()))
 
     def close(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.cml_loader_destroy(self._h)
+        with self._lock:
+            h = self._h if hasattr(self, "_h") else None
             self._h = None
+        if h:
+            # destroy outside the lock: it joins producer threads and
+            # wakes blocked consumers, either of which may grab the lock
+            self._lib.cml_loader_destroy(h)
 
     def __enter__(self):
         return self

@@ -29,6 +29,8 @@ import time
 from collections import deque
 from typing import Any, Iterable
 
+from consensusml_tpu.analysis import guarded_by
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -69,8 +71,15 @@ class _Metric:
         raise NotImplementedError
 
 
+@guarded_by("_lock", "_value")
 class Counter(_Metric):
-    """Monotonically increasing float (Prometheus ``counter``)."""
+    """Monotonically increasing float (Prometheus ``counter``).
+
+    Updated from the train loop, the prefetch/native producer threads
+    and the flight recorder's dump path concurrently — every ``_value``
+    access (reads included: a torn read exports garbage to a scraper)
+    holds the metric lock, enforced by the cml-check lock pass.
+    """
 
     kind = "counter"
 
@@ -86,15 +95,19 @@ class Counter(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def expose(self) -> list[str]:
-        return [f"{self.name} {_fmt(self._value)}"]
+        with self._lock:
+            return [f"{self.name} {_fmt(self._value)}"]
 
     def value_dict(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
+@guarded_by("_lock", "_value")
 class Gauge(_Metric):
     """Point-in-time float (Prometheus ``gauge``)."""
 
@@ -114,20 +127,28 @@ class Gauge(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def expose(self) -> list[str]:
-        return [f"{self.name} {_fmt(self._value)}"]
+        with self._lock:
+            return [f"{self.name} {_fmt(self._value)}"]
 
     def value_dict(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
+@guarded_by("_lock", "_counts", "_sum", "_count")
 class Histogram(_Metric):
     """Fixed-bucket cumulative histogram (Prometheus ``histogram``).
 
     Buckets are chosen at registration and never reallocated — an
     ``observe`` is a bisect + two adds, cheap enough for every round.
+    Exporters snapshot counts/sum/count under the same lock the
+    observers hold: an unlocked export could emit a cumulative bucket
+    row that disagrees with ``_sum`` (torn between two observes), which
+    Prometheus rate math turns into negative latencies.
     """
 
     kind = "histogram"
@@ -155,32 +176,40 @@ class Histogram(_Metric):
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
+
+    def _snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
 
     def expose(self) -> list[str]:
+        counts, total, n = self._snapshot()
         lines = []
         cum = 0
-        for le, n in zip(self.buckets, self._counts):
-            cum += n
+        for le, c in zip(self.buckets, counts):
+            cum += c
             lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {cum}')
-        cum += self._counts[-1]
+        cum += counts[-1]
         lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{self.name}_sum {_fmt(self._sum)}")
-        lines.append(f"{self.name}_count {self._count}")
+        lines.append(f"{self.name}_sum {_fmt(total)}")
+        lines.append(f"{self.name}_count {n}")
         return lines
 
     def value_dict(self) -> dict[str, Any]:
+        counts, total, n = self._snapshot()
         return {
-            "count": self._count,
-            "sum": self._sum,
+            "count": n,
+            "sum": total,
             "buckets": {
-                _fmt(le): n for le, n in zip(self.buckets, self._counts)
+                _fmt(le): c for le, c in zip(self.buckets, counts)
             },
-            "inf": self._counts[-1],
+            "inf": counts[-1],
         }
 
 
@@ -194,8 +223,15 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+@guarded_by("_lock", "_metrics", "_snapshots")
 class MetricsRegistry:
-    """Get-or-create metric registry with Prometheus / JSONL exporters."""
+    """Get-or-create metric registry with Prometheus / JSONL exporters.
+
+    Written from the prefetch thread (feed metrics), the train loop
+    (round metrics) and the flight recorder's crash-dump path (which
+    snapshots mid-signal) — registry structures only move under
+    ``_lock``; individual metric values ride each metric's own lock.
+    """
 
     def __init__(self, snapshot_keep: int = 64):
         self._metrics: dict[str, _Metric] = {}
@@ -259,11 +295,16 @@ class MetricsRegistry:
         if extra:
             snap.update(extra)
         snap["metrics"] = {m.name: m.value_dict() for m in self.metrics()}
-        self._snapshots.append(snap)
+        with self._lock:
+            self._snapshots.append(snap)
         return snap
 
     def snapshots(self) -> list[dict[str, Any]]:
-        return list(self._snapshots)
+        # list(deque) while another thread appends raises "deque mutated
+        # during iteration" — exactly the flight-recorder-dump-during-
+        # telemetry-snapshot race
+        with self._lock:
+            return list(self._snapshots)
 
     def write_jsonl_snapshot(
         self, fileobj, extra: dict[str, Any] | None = None

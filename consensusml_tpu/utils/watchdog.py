@@ -24,9 +24,12 @@ import sys
 import threading
 import time
 
+from consensusml_tpu.analysis import guarded_by
+
 __all__ = ["ProgressWatchdog"]
 
 
+@guarded_by("_lock", "_last", "_tag", "_armed")
 class ProgressWatchdog:
     """Hard-exit the process if :meth:`beat` stops arriving.
 
@@ -34,6 +37,12 @@ class ProgressWatchdog:
     the monitor thread fires when ``timeout_s`` elapses without one and
     exits the process with ``exit_code`` (distinct from normal failure
     exits so launchers can tell "peer loss" from "bad config").
+
+    The (deadline, tag, armed) triple moves under ``_lock`` so the
+    monitor always reads a CONSISTENT beat — the old lock-free beat
+    could time out on a fresh ``_last`` while printing a stale ``_tag``
+    in the diagnostic, or miss a ``pause()`` racing a ``beat()``. One
+    uncontended lock per ROUND (not per step) is noise.
     """
 
     def __init__(
@@ -58,6 +67,7 @@ class ProgressWatchdog:
         # ``exit_fn`` exists for tests: the timeout path is otherwise
         # untestable in-process (os._exit skips pytest entirely)
         self._exit_fn = exit_fn
+        self._lock = threading.Lock()
         self._armed = not arm_on_first_beat
         self._last = time.monotonic()
         self._tag: object = None
@@ -65,7 +75,8 @@ class ProgressWatchdog:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "ProgressWatchdog":
-        self._last = time.monotonic()
+        with self._lock:
+            self._last = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, name="progress-watchdog", daemon=True
         )
@@ -73,18 +84,20 @@ class ProgressWatchdog:
         return self
 
     def beat(self, tag: object = None) -> None:
-        """Record progress (cheap: two attribute stores, no locking —
-        monotonic staleness is the only thing the monitor reads)."""
-        self._last = time.monotonic()
-        self._tag = tag
-        self._armed = True
+        """Record progress (one uncontended lock + two stores; called
+        once per round)."""
+        with self._lock:
+            self._last = time.monotonic()
+            self._tag = tag
+            self._armed = True
 
     def pause(self) -> None:
         """Suspend deadline enforcement until the next :meth:`beat` —
         for phases with a legitimately unbounded first cost (a periodic
         eval's XLA compile) that must not read as a dead peer. The clock
         restarts from the resuming beat."""
-        self._armed = False
+        with self._lock:
+            self._armed = False
 
     def stop(self) -> None:
         self._stop.set()
@@ -93,15 +106,18 @@ class ProgressWatchdog:
     def _run(self) -> None:
         poll = min(1.0, self.timeout_s / 4)
         while not self._stop.wait(poll):
-            if not self._armed:
-                self._last = time.monotonic()  # clock starts at first beat
-                continue
-            stalled = time.monotonic() - self._last
+            with self._lock:
+                if not self._armed:
+                    # clock starts at first beat
+                    self._last = time.monotonic()
+                    continue
+                stalled = time.monotonic() - self._last
+                tag = self._tag
             if stalled > self.timeout_s:
                 reason = (
                     f"no {self.label} progress for "
                     f"{stalled:.0f}s (timeout {self.timeout_s:.0f}s, last "
-                    f"progress: {self._tag})"
+                    f"progress: {tag})"
                 )
                 print(
                     f"watchdog: {reason}; a peer process has likely "

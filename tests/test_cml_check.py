@@ -1,0 +1,536 @@
+"""cml-check static-analysis suite: known-bad fixtures must be caught,
+the repo itself must be clean (modulo the checked-in baseline).
+
+Run standalone with ``pytest -m analysis``; part of tier-1 (not slow).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from consensusml_tpu.analysis import (
+    Finding,
+    load_baseline,
+    split_suppressed,
+)
+from consensusml_tpu.analysis import host_sync, locks, schedule
+from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+from consensusml_tpu.topology import RingTopology, Shift
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "cml_check.py")
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# host-sync lint: known-bad snippets
+# ---------------------------------------------------------------------------
+
+
+def _lint(src: str):
+    return host_sync.lint_source(textwrap.dedent(src), "fixture.py")
+
+
+def test_sync_in_jitted_function_is_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x + 1
+            jax.block_until_ready(y)
+            return y
+        """
+    )
+    assert "sync-in-traced" in _rules(fs)
+
+
+def test_numpy_in_scan_body_is_flagged():
+    fs = _lint(
+        """
+        import jax
+        import numpy as np
+
+        def outer(xs):
+            def body(carry, x):
+                return carry + np.asarray(x), None
+            return jax.lax.scan(body, 0.0, xs)
+        """
+    )
+    assert "numpy-in-traced" in _rules(fs)
+
+
+def test_time_in_shard_mapped_function_is_flagged():
+    fs = _lint(
+        """
+        import time
+        import jax
+
+        def per_worker(x):
+            t0 = time.time()
+            return x * t0
+
+        def build(mesh, P):
+            return jax.shard_map(per_worker, mesh=mesh, in_specs=P, out_specs=P)
+        """
+    )
+    assert "time-in-traced" in _rules(fs)
+
+
+def test_branch_on_traced_param_is_flagged_but_static_forms_are_not():
+    fs = _lint(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(x, state, cfg):
+            if x > 0:            # BAD: tracer truthiness
+                x = x - 1
+            if state is None:    # ok: presence check
+                x = x + 1
+            if cfg.h > 2:        # ok: attribute access = static config
+                x = x * 2
+            if len(x) > 1:       # ok: static shape info
+                x = x + 2
+            return x
+        """
+    )
+    hits = [f for f in fs if f.rule == "branch-on-traced"]
+    assert [f.detail for f in hits] == ["x"]
+
+
+def test_item_in_vmapped_function_is_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        def f(x):
+            return x.item()
+
+        g = jax.vmap(f)
+        """
+    )
+    assert "item-in-traced" in _rules(fs)
+
+
+def test_nested_and_called_functions_inherit_tracedness():
+    fs = _lint(
+        """
+        import jax
+
+        def helper(x):
+            jax.device_get(x)   # traced via call from `step`
+            return x
+
+        @jax.jit
+        def step(x):
+            def inner(y):
+                return y.tolist()   # traced via nesting
+            return helper(x)
+        """
+    )
+    rules = _rules(fs)
+    assert "sync-in-traced" in rules and "item-in-traced" in rules
+
+
+def test_host_side_sync_is_inventoried_not_traced_rule():
+    fs = _lint(
+        """
+        import jax
+
+        def save(state):
+            return jax.device_get(state)
+        """
+    )
+    assert _rules(fs) == ["host-sync"]
+    assert fs[0].symbol == "save"
+
+
+def test_clean_traced_code_has_no_findings():
+    fs = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, rng):
+            y = jnp.where(x > 0, x, -x)
+            if rng is None:
+                return y
+            return y + jax.random.normal(rng, y.shape)
+        """
+    )
+    assert fs == []
+
+
+def test_tree_map_is_not_mistaken_for_lax_map():
+    fs = _lint(
+        """
+        import jax
+
+        def place(batch):
+            return jax.tree.map(lambda x: x if x.ndim else x, batch)
+        """
+    )
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline lint
+# ---------------------------------------------------------------------------
+
+
+def _lint_locks(src: str):
+    return locks.lint_source(textwrap.dedent(src), "fixture.py")
+
+
+_LOCK_FIXTURE = """
+    import threading
+    from consensusml_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_value", "_count")
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._value = 0        # ok: __init__ exempt
+            self._count = 0
+
+        def good(self):
+            with self._lock:
+                self._value += 1
+                return self._count
+
+        def bad_write(self):
+            self._value += 1       # finding
+
+        def bad_read(self):
+            return self._value     # finding
+
+        def bad_closure(self):
+            with self._lock:
+                def cb():
+                    return self._count   # finding: closure escapes
+                return cb
+
+        def unannotated_ok(self):
+            return id(self._lock)
+"""
+
+
+def test_lock_lint_flags_unguarded_access():
+    fs = _lint_locks(_LOCK_FIXTURE)
+    got = {(f.rule, f.symbol, f.detail) for f in fs}
+    assert ("unguarded-write", "Shared.bad_write", "_value") in got
+    assert ("unguarded-read", "Shared.bad_read", "_value") in got
+    assert (
+        "unguarded-read", "Shared.bad_closure.<locals>.cb", "_count"
+    ) in got
+    # nothing else: __init__ and with-lock accesses are clean
+    assert len(fs) == 3
+
+
+def test_lock_lint_flags_escaping_lambda_even_under_lock():
+    """A lambda is a closure: written under the lock, handed to a
+    thread, run without it — must be analyzed with an empty lock set
+    exactly like a nested def."""
+    fs = _lint_locks(
+        """
+        from consensusml_tpu.analysis import guarded_by
+
+        @guarded_by("_lock", "_value")
+        class Shared:
+            def leak(self, spawn):
+                with self._lock:
+                    return spawn(target=lambda: self._value + 1)
+        """
+    )
+    assert [(f.rule, f.symbol) for f in fs] == [
+        ("unguarded-read", "Shared.leak.<locals>.<lambda>")
+    ]
+
+
+def test_lock_lint_ignores_classes_without_annotation():
+    fs = _lint_locks(
+        """
+        class Plain:
+            def touch(self):
+                self._value = 1
+        """
+    )
+    assert fs == []
+
+
+def test_guarded_by_records_contract_at_runtime():
+    from consensusml_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_a")
+    @guarded_by("_other", "_b")
+    class C:
+        pass
+
+    assert C.__guarded_by__ == {"_a": "_lock", "_b": "_other"}
+
+
+def test_repo_threaded_modules_are_annotated_and_clean():
+    """The four threaded host-side modules carry @guarded_by and pass
+    the lint — the satellite contract of this PR."""
+    for rel in (
+        "consensusml_tpu/obs/metrics.py",
+        "consensusml_tpu/data/prefetch.py",
+        "consensusml_tpu/native/__init__.py",
+        "consensusml_tpu/utils/watchdog.py",
+    ):
+        path = os.path.join(REPO, rel)
+        fs = locks.lint_file(path, REPO)
+        assert fs == [], f"{rel}: {[f.render() for f in fs]}"
+        src = open(path).read()
+        assert "guarded_by(" in src, f"{rel} lost its annotations"
+
+
+# ---------------------------------------------------------------------------
+# schedule verifier
+# ---------------------------------------------------------------------------
+
+LEAVES = [((64, 8), "float32"), ((32,), "bfloat16"), ((513,), "float32")]
+
+
+@pytest.mark.parametrize("bucket_bytes", [0, 4 * 2**20])
+@pytest.mark.parametrize("name", sorted(schedule.builtin_topologies(8)))
+def test_every_topology_schedule_verifies(name, bucket_bytes):
+    """Satellite: every shipped topology x bucket_bytes in {0 (per-leaf),
+    4MiB} materializes a deadlock-free, bijective schedule — exact and
+    (static graphs) compressed."""
+    from consensusml_tpu.compress import topk_int8_compressor
+
+    topo = schedule.builtin_topologies(8)[name]
+    bb = bucket_bytes or None  # 0 == per-leaf wire (GossipConfig contract)
+    engines = [ConsensusEngine(GossipConfig(topology=topo, bucket_bytes=bb))]
+    if not topo.is_time_varying:
+        engines.append(
+            ConsensusEngine(
+                GossipConfig(
+                    topology=topo,
+                    compressor=topk_int8_compressor(
+                        ratio=0.1, chunk=128, impl="jnp"
+                    ),
+                    gamma=0.5,
+                    bucket_bytes=bb,
+                )
+            )
+        )
+    for eng in engines:
+        fs = schedule.verify_engine(eng, LEAVES, source=f"test:{name}")
+        assert fs == [], [f.render() for f in fs]
+
+
+class _AsymmetricRing(RingTopology):
+    """Deliberately broken: rank 0 gossips with different offsets than
+    everyone else — the static form of a rank-divergent ppermute."""
+
+    def rank_shifts(self, rank):
+        if rank == 0:
+            return (Shift(0, +3, 1.0 / 3), Shift(0, -1, 1.0 / 3))
+        return self.shifts
+
+
+def test_asymmetric_topology_is_reported_as_deadlock_statically():
+    """The acceptance fixture: no mesh, no collective, no device — the
+    deadlock is proven from the materialized schedules alone."""
+    eng = ConsensusEngine(GossipConfig(topology=_AsymmetricRing(8)))
+    fs = schedule.verify_engine(eng, LEAVES, source="test:asym")
+    rules = _rules(fs)
+    assert "deadlock-endpoint-mismatch" in rules
+    # and the lint names both wedged endpoints of the first bad transfer
+    details = {f.detail for f in fs if f.rule == "deadlock-endpoint-mismatch"}
+    assert any(d.startswith("pos0:r0->") for d in details)
+
+
+def test_rank_dependent_collective_count_is_a_deadlock():
+    class ExtraShift(RingTopology):
+        def rank_shifts(self, rank):
+            if rank == 3:
+                return self.shifts + (Shift(0, +2, 0.0),)
+            return self.shifts
+
+    eng = ConsensusEngine(GossipConfig(topology=ExtraShift(8)))
+    fs = schedule.verify_engine(eng, LEAVES, source="test:count")
+    assert _rules(fs) == ["deadlock-op-count"]
+
+
+def test_non_bijective_perm_is_flagged():
+    ops = [
+        [
+            schedule.RankOp(
+                "ppermute", "workers", "leaf0", (8,), "float32",
+                send_to=0 if r < 2 else r, recv_from=(r + 1) % 4,
+            )
+        ]
+        for r in range(4)
+    ]
+    fs = schedule.verify_schedules(ops, source="test:nonbij", topology=None)
+    assert "perm-not-bijective" in _rules(fs)
+
+
+def test_payload_mismatch_across_ranks_is_flagged():
+    mk = lambda dtype: [
+        schedule.RankOp(
+            "ppermute", "workers", "leaf0", (8,), dtype,
+            send_to=(r + 1) % 4, recv_from=(r - 1) % 4,
+        )
+        for r in range(4)
+    ]
+    ops = [[op] for op in mk("float32")]
+    ops[2] = [mk("bfloat16")[2]]  # rank 2 ships a different dtype
+    fs = schedule.verify_schedules(ops, source="test:dtype", topology=None)
+    assert "deadlock-op-mismatch" in _rules(fs)
+
+
+def test_schedule_matches_engine_bucketing():
+    """The materializer uses the engine's own plan: shrinking
+    bucket_bytes must grow the per-shift op count accordingly."""
+    topo = RingTopology(4)
+    leaves = [((4096,), "float32"), ((4096,), "float32")]
+    ops_for = lambda bb: len(
+        schedule.materialize_schedules(
+            ConsensusEngine(
+                GossipConfig(topology=topo, bucket_bytes=bb)
+            ),
+            leaves,
+        )[0]
+    )
+    assert ops_for(1 << 20) == 2  # one bucket x two shifts
+    assert ops_for(8 * 1024) == 4  # two buckets x two shifts
+    assert ops_for(None) == 4  # per-leaf x two shifts
+
+
+# ---------------------------------------------------------------------------
+# jaxpr contracts
+# ---------------------------------------------------------------------------
+
+
+def test_jaxpr_contracts_mnist_and_gpt2_clean():
+    from consensusml_tpu.analysis import jaxpr_contracts
+
+    for name in ("mnist_mlp", "gpt2_topk"):
+        fs = jaxpr_contracts.check_config(name)
+        assert fs == [], [f.render() for f in fs]
+
+
+def test_jaxpr_callback_detector_sees_callbacks():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensusml_tpu.analysis.jaxpr_contracts import count_primitives
+
+    def bad(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            x,
+        )
+        return jnp.sum(y)
+
+    counts = count_primitives(jax.make_jaxpr(bad)(jnp.ones((4,))))
+    assert any("callback" in k for k in counts), counts
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_suppression_and_stale_reporting(tmp_path):
+    f1 = Finding("host-sync", "host-sync", "a.py", "f", "device_get", "m", 1)
+    f2 = Finding("host-sync", "host-sync", "b.py", "g", "device_get", "m", 2)
+    bl = tmp_path / "baseline"
+    bl.write_text(
+        f"# comment\n{f1.id}  # inline comment\nhost-sync:gone:entry:x:y\n"
+    )
+    active, suppressed, stale = split_suppressed(
+        [f1, f2], load_baseline(str(bl))
+    )
+    assert [f.id for f in active] == [f2.id]
+    assert [f.id for f in suppressed] == [f1.id]
+    assert stale == ["host-sync:gone:entry:x:y"]
+
+
+def test_finding_id_is_line_number_stable():
+    a = Finding("locks", "unguarded-read", "m.py", "C.f", "_x", "msg", 10)
+    b = Finding("locks", "unguarded-read", "m.py", "C.f", "_x", "msg", 99)
+    assert a.id == b.id
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the CLI sets its own device count
+    return subprocess.run(
+        [sys.executable, CLI, *args],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout, env=env,
+    )
+
+
+def test_cli_all_exits_zero_on_repo():
+    """`python tools/cml_check.py --all` is the tier-1 gate: the repo is
+    clean under the checked-in baseline, machine-readably."""
+    res = _run_cli("--all", "--json", "-")
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["ok"] is True
+    assert doc["findings"] == []
+    assert doc["counts"]["suppressed"] >= 1  # the intentional-sync inventory
+    assert doc["counts"]["stale"] == 0, doc["stale_baseline"]
+    assert set(doc["passes"]) == {"host-sync", "locks", "schedule", "jaxpr"}
+
+
+def test_cli_path_restricted_run_does_not_report_foreign_stale(tmp_path):
+    """`--paths` narrowing must not flag baseline entries for files the
+    run never scanned as stale (a developer would prune live
+    suppressions)."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    res = _run_cli(
+        "--host-sync", "--paths", str(clean), "--json", "-", timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["stale_baseline"] == []
+
+
+def test_cli_exits_nonzero_on_bad_fixture(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                jax.block_until_ready(x)
+                return x
+            """
+        )
+    )
+    res = _run_cli(
+        "--host-sync", "--paths", str(bad), "--baseline", "none",
+        "--json", "-", timeout=120,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert any(f["rule"] == "sync-in-traced" for f in doc["findings"])

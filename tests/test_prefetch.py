@@ -31,6 +31,43 @@ needs_native = pytest.mark.skipif(
 # ---------------------------------------------------------------------------
 
 
+def test_close_from_another_thread_unblocks_waiting_consumer():
+    """A consumer blocked in __next__'s queue pop must wake to
+    StopIteration when another thread (teardown, GC __del__) closes the
+    prefetcher — even though close() drains the queue and the stopped
+    producer never re-posts the end-of-stream sentinel."""
+
+    def slow_source():
+        yield {"x": np.zeros((2,), np.float32)}
+        # block until closed: the consumer will be waiting on an empty
+        # queue when close() arrives
+        stop_evt.wait(timeout=20)
+
+    stop_evt = threading.Event()
+    pf = DevicePrefetcher(slow_source(), depth=1)
+    assert next(pf) is not None  # drain the one staged batch
+
+    result = {}
+
+    def consume():
+        try:
+            next(pf)
+            result["outcome"] = "item"
+        except StopIteration:
+            result["outcome"] = "stop"
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time as _time
+
+    _time.sleep(0.2)  # let the consumer park in queue.get()
+    pf.close()
+    stop_evt.set()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer never woke after close()"
+    assert result["outcome"] == "stop"
+
+
 def test_prefetcher_preserves_order_and_counts():
     src = [{"x": np.full((4,), i, np.float32)} for i in range(7)]
     pf = DevicePrefetcher(iter(src), depth=2)

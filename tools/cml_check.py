@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""cml-check: static analysis gate for the gossip training stack.
+
+Runs the four analysis passes (see docs/static_analysis.md) and exits
+non-zero on any finding not suppressed by the baseline file:
+
+    python tools/cml_check.py --all                # the tier-1 gate
+    python tools/cml_check.py --host-sync --locks  # AST passes only (fast)
+    python tools/cml_check.py --all --json -       # machine-readable
+    python tools/cml_check.py --all --write-baseline  # refresh allowlist
+
+Passes:
+  --host-sync   AST lint: device syncs / numpy / wall-clock / Python
+                branching inside jit/scan/shard_map-traced code, plus the
+                package-wide inventory of intentional host syncs
+  --schedule    per-rank ppermute schedule verifier over every shipped
+                topology x wire layout (static deadlock check)
+  --jaxpr       traced train-step contracts per config: no host
+                callbacks, no f64, collective count == verified
+                schedule, no round-to-round recompile
+  --locks       lock-discipline race lint over @guarded_by classes
+
+Exit codes: 0 clean (or everything suppressed), 1 active findings,
+2 internal error. CPU-only, trace-only: safe on any dev box and in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# must happen before the first jax import (schedule/jaxpr passes): the
+# virtual 8-device CPU mesh tests/conftest.py uses, minus pytest
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from consensusml_tpu.analysis import (  # noqa: E402
+    load_baseline,
+    render_report,
+    split_suppressed,
+    to_json,
+)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, ".cml-check-baseline")
+AST_PASS_PATHS = [os.path.join(_REPO_ROOT, "consensusml_tpu")]
+
+
+def _force_cpu():
+    """The TPU plugin on some boxes force-sets jax_platforms at
+    interpreter start (sitecustomize), overriding the env var — pin CPU
+    after import too (same dance as tests/conftest.py)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def run_passes(selected: list[str], roots: list[str]):
+    findings = []
+    if "host-sync" in selected:
+        from consensusml_tpu.analysis import host_sync
+
+        findings += host_sync.lint_paths(roots, _REPO_ROOT)
+    if "locks" in selected:
+        from consensusml_tpu.analysis import locks
+
+        findings += locks.lint_paths(roots, _REPO_ROOT)
+    if "schedule" in selected:
+        _force_cpu()
+        from consensusml_tpu.analysis import schedule
+
+        findings += schedule.run_builtin()
+    if "jaxpr" in selected:
+        _force_cpu()
+        from consensusml_tpu.analysis import jaxpr_contracts
+
+        findings += jaxpr_contracts.check_all_configs()
+    return findings
+
+
+def write_baseline(path: str, findings) -> None:
+    ids = sorted({f.id for f in findings})
+    with open(path, "w") as f:
+        f.write(
+            "# cml-check suppression baseline (docs/static_analysis.md).\n"
+            "# One finding id per line; '#' comments. Every entry is an\n"
+            "# INTENTIONAL sync/access — say why when you add one.\n"
+        )
+        for i in ids:
+            f.write(i + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cml-check", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--all", action="store_true", help="run all four passes")
+    ap.add_argument("--host-sync", action="store_true")
+    ap.add_argument("--schedule", action="store_true")
+    ap.add_argument("--jaxpr", action="store_true")
+    ap.add_argument("--locks", action="store_true")
+    ap.add_argument(
+        "--paths", nargs="*", default=None,
+        help="files/dirs for the AST passes (default: consensusml_tpu/)",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="suppression file (default: .cml-check-baseline; "
+        "'none' disables)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--json", metavar="PATH",
+        help="write machine-readable findings to PATH ('-' = stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    selected = [
+        name
+        for name, on in (
+            ("host-sync", args.host_sync),
+            ("locks", args.locks),
+            ("schedule", args.schedule),
+            ("jaxpr", args.jaxpr),
+        )
+        if on or args.all
+    ]
+    if not selected:
+        ap.error("pick at least one pass (or --all)")
+    roots = args.paths if args.paths else AST_PASS_PATHS
+
+    try:
+        findings = run_passes(selected, roots)
+    except Exception as e:
+        print(f"cml-check: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+    if args.write_baseline:
+        path = (
+            args.baseline if args.baseline != "none" else DEFAULT_BASELINE
+        )
+        write_baseline(path, findings)
+        print(
+            f"cml-check: wrote {len({f.id for f in findings})} "
+            f"suppression(s) to {path}"
+        )
+        return 0
+
+    baseline = load_baseline(
+        None if args.baseline == "none" else args.baseline
+    )
+    active, suppressed, stale = split_suppressed(findings, baseline)
+    # an entry is only stale if THIS invocation could have re-found it:
+    # its pass must have run, and for the AST passes the file named in
+    # the id (3rd field) must lie under the scanned --paths
+    scanned = [os.path.relpath(os.path.abspath(p), _REPO_ROOT) for p in roots]
+
+    def _could_refind(sid: str) -> bool:
+        parts = sid.split(":")
+        if parts[0] not in selected:
+            return False
+        if parts[0] in ("host-sync", "locks") and len(parts) > 2:
+            f = parts[2]
+            return any(
+                f == r or f.startswith(r.rstrip(os.sep) + os.sep) or r == "."
+                for r in scanned
+            )
+        return True
+
+    stale = [s for s in stale if _could_refind(s)]
+
+    report = render_report(
+        active, suppressed, stale, passes_run=selected
+    )
+    if args.json:
+        out = to_json(active, suppressed, stale, passes_run=selected)
+        if args.json == "-":
+            print(out)
+        else:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+            print(report)
+    else:
+        print(report)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
