@@ -632,6 +632,67 @@ def _fed_bench(batch: int, steps: int, image: int) -> dict:
     return out
 
 
+def _serving_bench() -> dict:
+    """Serving SLO section: the KV-cache decode engine under open-loop
+    Poisson load (tools/loadgen core) on an in-process consensus-mean
+    model. Reports tokens/s, TTFT p50/p99, mean batch occupancy, and the
+    zero-recompile check (compile counts before vs after load)."""
+    import jax
+
+    if os.environ.get("BENCH_DEVICE"):
+        jax.config.update("jax_platforms", os.environ["BENCH_DEVICE"])
+    import numpy as np
+
+    from consensusml_tpu import configs
+    from consensusml_tpu.serve import Engine, ServeConfig
+    from consensusml_tpu.utils.tree import consensus_mean
+    from tools.loadgen import _engine_submit, run_loadgen
+
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "64"))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "100"))
+    bundle = configs.build("gpt2_topk", "smoke")
+    # consensus-of-W random inits stands in for a trained artifact: the
+    # serving COST is architecture-shaped, not weight-shaped
+    stacked = jax.vmap(bundle.init_params)(
+        jax.random.split(jax.random.key(0), bundle.world_size)
+    )
+    params = consensus_mean(stacked)
+    engine = Engine(
+        bundle.model, params,
+        ServeConfig(num_slots=8, max_len=32, max_new_tokens=8),
+    )
+    warm = engine.warmup()
+    report = run_loadgen(
+        _engine_submit(engine),
+        n_requests=n_requests,
+        rate_rps=rate,
+        prompt_lens=(2, 20),
+        vocab=bundle.model.config.vocab_size,
+        max_new_tokens=8,
+    )
+    stats = engine.stats()
+    engine.shutdown()
+    return {
+        "platform": jax.default_backend(),
+        "config": "gpt2_topk smoke, 8 slots, max_len 32, 8 new tokens",
+        "requests": n_requests,
+        "offered_rate_rps": rate,
+        "tokens_per_sec": round(report["tokens_per_sec"], 1),
+        "decode_tokens_per_sec": round(stats["decode_tokens_per_sec"], 1),
+        "ttft_p50_ms": round(report["ttft_p50_ms"], 2),
+        "ttft_p99_ms": round(report["ttft_p99_ms"], 2),
+        "intertoken_p50_ms": round(stats["intertoken_p50_ms"], 3),
+        "intertoken_p99_ms": round(stats["intertoken_p99_ms"], 3),
+        "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
+        "errors": report["errors"],
+        "zero_recompiles_after_warmup": (
+            stats["compile_counts"]["prefill"] == warm["prefill"]
+            and stats["compile_counts"]["decode"] == warm["decode"]
+        ),
+        "compile_counts": stats["compile_counts"],
+    }
+
+
 def _gossip_round_bench() -> dict:
     """Cost of ONE full-model CHOCO compressed-gossip round at the
     config-5 scale: compress + decompress + xhat/s innovation update over
@@ -1017,6 +1078,9 @@ def main() -> None:
     if "--_gossip_round" in sys.argv:
         print("INNER_RESULT " + json.dumps(_gossip_round_bench()), flush=True)
         return
+    if "--_serving" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_serving_bench()), flush=True)
+        return
     if "--_fed" in sys.argv:
         batch = int(os.environ.get("BENCH_BATCH", "128"))
         # its own step count: at ~0.9 s/round of tunnel feed x3 feed
@@ -1238,6 +1302,9 @@ def main() -> None:
     sections.append(("attention", "--_attention", 900, micro_env))
     sections.append(("gpt2", "--_gpt2", 900, micro_env))
     sections.append(("gossip_round", "--_gossip_round", 1500, micro_env))
+    # serving SLOs (tokens/s, TTFT p50/p99, occupancy) on the KV-cache
+    # decode engine — CPU-capable: the smoke model is tiny
+    sections.append(("serving", "--_serving", 600, micro_env))
     if tpu_ok:  # host->device transfer bench is meaningless without the tunnel
         sections.append(("fed_input", "--_fed", 1500, None))
 

@@ -121,25 +121,16 @@ def _stacked_state_and_batch(bundle):
     return state, _shape_only(batch)
 
 
-def _check_step_jaxpr(name: str, bundle) -> list[Finding]:
-    import jax
-
-    from consensusml_tpu.train import make_simulated_train_step
-
+def _callback_f64_findings(closed, mk, what: str) -> list[Finding]:
+    """The two program-purity contracts shared by the train step and the
+    serving decode step: no host callbacks, no f64/complex128."""
     findings: list[Finding] = []
-    mk = lambda rule, detail, msg: Finding(
-        PASS, rule, f"configs:{name}", "train_step", detail, msg
-    )
-    step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
-    state, batch = _stacked_state_and_batch(bundle)
-    closed = jax.make_jaxpr(step)(state, batch)
-
     counts = count_primitives(closed)
     for prim in sorted(set(counts) & _CALLBACK_PRIMS):
         findings.append(
             mk(
                 "host-callback", prim,
-                f"train step traces a host callback ({prim} x"
+                f"{what} traces a host callback ({prim} x"
                 f"{counts[prim]}): every round would fence the device "
                 "pipeline on the host",
             )
@@ -155,12 +146,27 @@ def _check_step_jaxpr(name: str, bundle) -> list[Finding]:
         findings.append(
             mk(
                 "f64-promotion", f"{prim}:{dt}",
-                f"train step computes in {dt} (via {prim}): doubles "
+                f"{what} computes in {dt} (via {prim}): doubles "
                 "wire and HBM on a path budgeted in f32 — find the "
                 "promoting op (python float op on a traced value, "
                 "np.float64 constant, ...)",
             )
         )
+    return findings
+
+
+def _check_step_jaxpr(name: str, bundle) -> list[Finding]:
+    import jax
+
+    from consensusml_tpu.train import make_simulated_train_step
+
+    mk = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "train_step", detail, msg
+    )
+    step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
+    state, batch = _stacked_state_and_batch(bundle)
+    closed = jax.make_jaxpr(step)(state, batch)
+    findings = _callback_f64_findings(closed, mk, "train step")
 
     # recompile contract: round r's OUTPUT shapes, fed back as round
     # r+1's input, must retrace to the identical program
@@ -291,13 +297,84 @@ def _check_collective_count(name: str, bundle) -> list[Finding]:
     return findings
 
 
+def _check_decode_jaxpr(name: str, bundle) -> list[Finding]:
+    """Serving decode-step contracts (causal-LM configs only).
+
+    Steady-state serving lives and dies by the same compiled-program
+    invariants as training: a host callback inside the decode step
+    fences the device once PER TOKEN, f64 doubles the KV cache, and a
+    program whose signature drifts between consecutive decode steps
+    recompiles mid-request — the serving engine's zero-recompile
+    contract (docs/serving.md). Traced abstractly on the exact jit the
+    engine runs (:func:`consensusml_tpu.serve.decode.make_decode_fn`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve import decode as D
+
+    if bundle.model is None or not D.supports_decode(bundle.model):
+        return []
+    mk = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "decode_step", detail, msg
+    )
+    dm = D.DecodeModel.wrap(bundle.model)
+    slots, max_len = 4, min(dm.max_len, 32)
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    params = probe[0] if isinstance(probe, tuple) and len(probe) == 2 else probe
+    cache = jax.eval_shape(lambda: D.init_cache(dm, slots, max_len))
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    decode = D.make_decode_fn(dm)
+    closed = jax.make_jaxpr(decode)(params, cache, tokens, positions)
+    findings = _callback_f64_findings(closed, mk, "decode step")
+
+    # recompile contract: step r's OUTPUT cache, fed back as step r+1's
+    # input (exactly what the engine loop does every token), must trace
+    # to the byte-identical program — zero recompiles across decode
+    # steps at ANY slot occupancy / length mix (fill level is data)
+    out_tokens, out_cache = jax.eval_shape(decode, params, cache, tokens, positions)
+    h1 = _canonical_hash(closed)
+    h2 = _canonical_hash(jax.make_jaxpr(decode)(params, out_cache, out_tokens, positions))
+    if h1 != h2:
+        findings.append(
+            mk(
+                "recompile", "signature-hash",
+                "decode step r+1 (fed step r's output cache) traces to a "
+                "DIFFERENT program than step r — the engine recompiles "
+                "mid-request; diff the two jaxprs for the drifting "
+                "dtype/shape/weak-type",
+            )
+        )
+    in_flat = jax.tree.leaves(cache)
+    out_flat = jax.tree.leaves(out_cache)
+    drift = [
+        (a.shape, a.dtype, b.shape, b.dtype)
+        for a, b in zip(in_flat, out_flat)
+        if a.shape != b.shape or a.dtype != b.dtype
+    ]
+    if len(in_flat) != len(out_flat) or drift:
+        findings.append(
+            mk(
+                "recompile", "cache-drift",
+                f"KV cache changes structure across a decode step "
+                f"({len(in_flat)} -> {len(out_flat)} leaves, "
+                f"{len(drift)} leaf shape/dtype changes): donation and "
+                "the jit cache both break",
+            )
+        )
+    return findings
+
+
 def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
-    """All jaxpr contracts for one config."""
+    """All jaxpr contracts for one config (incl. the serving decode step
+    on causal-LM configs)."""
     from consensusml_tpu import configs
 
     bundle = configs.build(name, scale=scale)
     findings = _check_step_jaxpr(name, bundle)
     findings.extend(_check_collective_count(name, bundle))
+    findings.extend(_check_decode_jaxpr(name, bundle))
     return findings
 
 

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 __all__ = [
     "dot_product_attention",
     "blockwise_attention",
+    "cached_attention",
+    "update_kv_cache",
     "apply_rope",
     "rope_frequencies",
 ]
@@ -221,6 +223,62 @@ def blockwise_attention(
     )
     denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
     return (out / denom).astype(dtype)
+
+
+def update_kv_cache(
+    cache: dict[str, jax.Array],
+    k: jax.Array,  # (B, 1, H, D) — the decode step's single new key
+    v: jax.Array,  # (B, 1, H, D)
+    positions: jax.Array,  # (B,) per-row write index into the cache
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Write one decode step's K/V into per-row cache slots.
+
+    ``cache`` holds ``{"k": (B, T, H, D), "v": (B, T, H, D)}`` where each
+    batch row is an independent sequence slot (the serving engine's
+    continuous batcher packs unrelated requests into the rows, each at its
+    own length). Rows write at DIFFERENT positions — a per-row scatter,
+    not a ``dynamic_update_slice`` — so one fused decode step serves the
+    whole batch regardless of how staggered the sequences are.
+
+    Returns ``(k_cache, v_cache, lengths)`` where ``lengths = positions+1``
+    counts the now-valid rows (the just-written token included), ready for
+    :func:`cached_attention`'s mask.
+    """
+    rows = jnp.arange(k.shape[0])
+    k_cache = cache["k"].at[rows, positions].set(
+        jnp.asarray(k[:, 0], cache["k"].dtype)
+    )
+    v_cache = cache["v"].at[rows, positions].set(
+        jnp.asarray(v[:, 0], cache["v"].dtype)
+    )
+    return k_cache, v_cache, positions + 1
+
+
+def cached_attention(
+    q: jax.Array,  # (B, 1, H, D)
+    k_cache: jax.Array,  # (B, T, H, D)
+    v_cache: jax.Array,  # (B, T, H, D)
+    *,
+    lengths: jax.Array,  # (B,) valid cache rows per slot
+    dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Decode-step attention over a KV cache.
+
+    The query is the single current token per slot; it attends to the
+    first ``lengths[b]`` cache rows of its own slot (everything at or
+    before its position — causality is enforced by the LENGTH mask, so no
+    causal matrix is needed for a one-row query). Cache rows past the
+    length carry stale garbage from earlier occupants of the slot; the
+    mask zeroes their probability exactly, so slot reuse needs no cache
+    clearing. Fixed shapes throughout: the compiled step is reused for
+    every decode step at every fill level (the serving engine's
+    zero-recompile contract, asserted by cml-check's decode jaxpr pass).
+    """
+    t = k_cache.shape[1]
+    kv_mask = jnp.arange(t)[None, :] < lengths[:, None]
+    return dot_product_attention(
+        q, k_cache, v_cache, kv_mask=kv_mask, dtype=dtype, impl="dense"
+    )
 
 
 def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> jax.Array:

@@ -16,7 +16,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from consensusml_tpu.models.attention import dot_product_attention
+from consensusml_tpu.models.attention import (
+    cached_attention,
+    dot_product_attention,
+    update_kv_cache,
+)
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
 
 __all__ = ["GPT2Config", "GPT2LM", "gpt2_medium", "gpt2_loss_fn"]
@@ -71,20 +75,41 @@ class _DecoderBlock(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(
+        self,
+        x,
+        deterministic: bool,
+        cache=None,
+        positions=None,
+        return_kv: bool = False,
+    ):
         c = self.config
         d_head = c.hidden // c.heads
         y = _layer_norm(c, "ln_1")(x)
         qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
+        if cache is not None:
+            # decode step: write this token's K/V into the slot cache and
+            # attend over the valid prefix (serve/ KV-cache path)
+            k_cache, v_cache, lengths = update_kv_cache(cache, k, v, positions)
+            attn = cached_attention(
+                q, k_cache, v_cache, lengths=lengths, dtype=c.dtype
+            )
+            new_cache = {"k": k_cache, "v": v_cache}
+        else:
+            attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
         attn = nn.DenseGeneral(c.hidden, axis=(-2, -1), dtype=c.dtype, name="out")(attn)
         x = x + nn.Dropout(c.dropout, deterministic=deterministic)(attn)
         y = _layer_norm(c, "ln_2")(x)
         y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(c.hidden, dtype=c.dtype, name="mlp_out")(y)
-        return x + nn.Dropout(c.dropout, deterministic=deterministic)(y)
+        out = x + nn.Dropout(c.dropout, deterministic=deterministic)(y)
+        if cache is not None:
+            return out, new_cache
+        if return_kv:
+            return out, (k, v)
+        return out
 
 
 class GPT2LM(nn.Module):
@@ -96,31 +121,67 @@ class GPT2LM(nn.Module):
         input_ids: jax.Array,
         deterministic: bool = True,
         return_hidden: bool = False,
-    ) -> jax.Array:
+        *,
+        positions: jax.Array | None = None,
+        kv_cache: list | None = None,
+        return_kv: bool = False,
+    ):
         """Logits (f32) by default; ``return_hidden=True`` returns the
         pre-head states (post final-LN, model dtype) instead — the
         chunked-vocab loss path computes the head inside the loss so the
-        full logits tensor is never materialized."""
+        full logits tensor is never materialized.
+
+        Serving hooks (:mod:`consensusml_tpu.serve`): ``return_kv=True``
+        additionally returns each layer's ``(k, v)`` — (B, S, H, D) — for
+        prefill cache insertion; ``kv_cache`` (a per-layer list of
+        ``{"k", "v"}`` slot caches) with ``positions`` ((B,) per-slot
+        token index) runs one single-token decode step against the cache
+        and returns ``(logits, new_kv_cache)``. The two are mutually
+        exclusive; the training/eval path passes neither and is
+        unchanged.
+        """
         c = self.config
+        if kv_cache is not None and return_kv:
+            raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
         b, s = input_ids.shape
+        if kv_cache is not None and s != 1:
+            raise ValueError(f"decode steps are single-token, got seq len {s}")
         tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="wte")
         x = tok_emb(input_ids)
-        pos = jnp.arange(s)[None, :]
+        pos = positions[:, None] if positions is not None else jnp.arange(s)[None, :]
         x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="wpe")(pos)
         x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
-        # static_argnums: `deterministic` is a python bool, not a tracer
+        # static_argnums: `deterministic` is a python bool, not a tracer.
+        # The serving paths (kv_cache / return_kv) bypass remat outright:
+        # remat is a BACKWARD-pass memory lever and inference has no
+        # backward — and the extra flag args would otherwise ride through
+        # nn.remat as tracers and break the python branches on them.
         block = (
             nn.remat(_DecoderBlock, static_argnums=(2,))
-            if c.remat
+            if c.remat and kv_cache is None and not return_kv
             else _DecoderBlock
         )
+        new_caches, kvs = [], []
         for i in range(c.layers):
-            x = block(c, name=f"h_{i}")(x, deterministic)
+            blk = block(c, name=f"h_{i}")
+            if kv_cache is not None:
+                x, layer_cache = blk(x, deterministic, kv_cache[i], positions)
+                new_caches.append(layer_cache)
+            elif return_kv:
+                x, kv = blk(x, deterministic, None, None, True)
+                kvs.append(kv)
+            else:
+                x = blk(x, deterministic)
         x = _layer_norm(c, "ln_f")(x)
         if return_hidden:
             return jnp.asarray(x, c.dtype)
         logits = tok_emb.attend(jnp.asarray(x, tok_emb.dtype))
-        return jnp.asarray(logits, jnp.float32)
+        logits = jnp.asarray(logits, jnp.float32)
+        if kv_cache is not None:
+            return logits, new_caches
+        if return_kv:
+            return logits, kvs
+        return logits
 
 
 def gpt2_loss_fn(model: GPT2LM):

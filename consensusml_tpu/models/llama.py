@@ -22,7 +22,13 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from consensusml_tpu.models.attention import apply_rope, dot_product_attention, rope_frequencies
+from consensusml_tpu.models.attention import (
+    apply_rope,
+    cached_attention,
+    dot_product_attention,
+    rope_frequencies,
+    update_kv_cache,
+)
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
 
 __all__ = ["LlamaConfig", "LlamaLM", "llama2_7b", "llama_tiny", "llama_loss_fn"]
@@ -112,7 +118,14 @@ class _LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, rope_table):
+    def __call__(
+        self,
+        x,
+        rope_table,
+        cache=None,
+        positions=None,
+        return_kv: bool = False,
+    ):
         c = self.config
         d = c.head_dim
         proj = lambda feats, name: LoRADense(
@@ -123,13 +136,27 @@ class _LlamaBlock(nn.Module):
         q = proj(c.heads * d, "q_proj")(y).reshape(b, s, c.heads, d)
         k = proj(c.kv_heads * d, "k_proj")(y).reshape(b, s, c.kv_heads, d)
         v = proj(c.kv_heads * d, "v_proj")(y).reshape(b, s, c.kv_heads, d)
-        q = apply_rope(q, rope_table)
-        k = apply_rope(k, rope_table)
-        if c.kv_heads != c.heads:  # grouped-query attention
-            rep = c.heads // c.kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
+        pos2d = positions[:, None] if positions is not None else None
+        q = apply_rope(q, rope_table, pos2d)
+        k = apply_rope(k, rope_table, pos2d)
+        rep = c.heads // c.kv_heads
+        if cache is not None:
+            # decode: cache stores PRE-repeat (kv_heads) rows — GQA
+            # expansion happens on the read, so the cache stays small
+            k_cache, v_cache, lengths = update_kv_cache(cache, k, v, positions)
+            new_cache = {"k": k_cache, "v": v_cache}
+            if rep != 1:
+                k_cache = jnp.repeat(k_cache, rep, axis=2)
+                v_cache = jnp.repeat(v_cache, rep, axis=2)
+            attn = cached_attention(
+                q, k_cache, v_cache, lengths=lengths, dtype=c.dtype
+            )
+        else:
+            kv = (k, v)  # pre-repeat, for prefill cache insertion
+            if rep != 1:  # grouped-query attention
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn = dot_product_attention(q, k, v, causal=True, dtype=c.dtype)
         x = x + proj(c.hidden, "o_proj")(attn.reshape(b, s, c.heads * d))
         y = RMSNorm(c.norm_eps, name="mlp_norm")(x)
         gate = nn.Dense(c.mlp_dim, use_bias=False, dtype=c.dtype, name="gate_proj")(y)
@@ -137,7 +164,12 @@ class _LlamaBlock(nn.Module):
         y = nn.Dense(c.hidden, use_bias=False, dtype=c.dtype, name="down_proj")(
             nn.silu(gate) * up
         )
-        return x + y
+        out = x + y
+        if cache is not None:
+            return out, new_cache
+        if return_kv:
+            return out, kv
+        return out
 
 
 class LlamaLM(nn.Module):
@@ -149,12 +181,35 @@ class LlamaLM(nn.Module):
         input_ids: jax.Array,
         deterministic: bool = True,
         return_hidden: bool = False,
-    ) -> jax.Array:
+        *,
+        positions: jax.Array | None = None,
+        kv_cache: list | None = None,
+        return_kv: bool = False,
+    ):
+        """Serving hooks mirror :class:`~consensusml_tpu.models.gpt2.GPT2LM`:
+        ``return_kv=True`` also returns per-layer pre-repeat ``(k, v)``
+        for prefill insertion; ``kv_cache`` + ``positions`` runs one
+        single-token decode step. The training path passes neither."""
         c = self.config
+        if kv_cache is not None and return_kv:
+            raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
+        if kv_cache is not None and input_ids.shape[1] != 1:
+            raise ValueError(
+                f"decode steps are single-token, got seq len {input_ids.shape[1]}"
+            )
         x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
         rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
+        new_caches, kvs = [], []
         for i in range(c.layers):
-            x = _LlamaBlock(c, name=f"layer_{i}")(x, rope_table)
+            blk = _LlamaBlock(c, name=f"layer_{i}")
+            if kv_cache is not None:
+                x, layer_cache = blk(x, rope_table, kv_cache[i], positions)
+                new_caches.append(layer_cache)
+            elif return_kv:
+                x, kv = blk(x, rope_table, None, positions, True)
+                kvs.append(kv)
+            else:
+                x = blk(x, rope_table)
         x = RMSNorm(c.norm_eps, name="final_norm")(x)
         head = nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")
         if return_hidden:  # chunked-loss path: head runs inside the loss
@@ -163,7 +218,12 @@ class LlamaLM(nn.Module):
             # creates them and XLA dead-code-eliminates it at runtime
             head(x[:, :1])
             return jnp.asarray(x, c.dtype)
-        return jnp.asarray(head(x), jnp.float32)
+        logits = jnp.asarray(head(x), jnp.float32)
+        if kv_cache is not None:
+            return logits, new_caches
+        if return_kv:
+            return logits, kvs
+        return logits
 
 
 def llama_loss_fn(model: LlamaLM):

@@ -1,0 +1,27 @@
+"""Consensus-checkpoint serving: export, KV-cache decode, batching.
+
+The train→serve handoff for the decentralized stack (ROADMAP north star:
+"serve heavy traffic"). A training run's stacked per-worker replicas
+collapse — via the SAME :func:`consensusml_tpu.utils.consensus_mean` the
+evaluator and elastic resume use — into a single serving artifact
+(:mod:`.export`), which a KV-cache decode engine (:mod:`.decode`) serves
+through a slot-based continuous batcher (:mod:`.batcher`,
+:class:`.engine.Engine`) and an optional threaded socket front-end
+(:mod:`.server`). The whole request path is SLO-instrumented
+(``consensusml_serve_*`` metric family + spans, docs/serving.md) and the
+decode step carries its own cml-check jaxpr contract: no host callbacks
+and ZERO recompiles across steady-state decode steps.
+"""
+
+from consensusml_tpu.serve.export import (  # noqa: F401
+    export_serving,
+    load_serving,
+    serving_meta,
+)
+from consensusml_tpu.serve.decode import (  # noqa: F401
+    DecodeModel,
+    init_cache,
+    supports_decode,
+)
+from consensusml_tpu.serve.engine import Engine, ServeConfig, load_engine  # noqa: F401
+from consensusml_tpu.serve.server import ServeServer  # noqa: F401

@@ -1,0 +1,130 @@
+"""Slot-based continuous batching: requests, handles, slot table.
+
+The scheduling model (docs/serving.md): the decode batch has ``S`` fixed
+slots. A request is admitted into a free slot by a PREFILL (one bucketed
+forward that also seeds the slot's KV cache and first token), then rides
+the shared per-token DECODE step with whatever else is in flight —
+admission never waits for the batch to drain, and a finishing sequence
+frees its slot for the next queued request between two decode steps
+(continuous batching, not static batching). All host-side bookkeeping
+lives here; the device-facing jits are :mod:`consensusml_tpu.serve.decode`
+and the loop that ties them together is :class:`.engine.Engine`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Iterator
+
+__all__ = ["Request", "RequestHandle", "GenResult", "SlotTable", "Slot"]
+
+_DONE = object()  # stream sentinel
+
+
+@dataclasses.dataclass
+class GenResult:
+    """Terminal record of one request."""
+
+    tokens: list[int]
+    finish_reason: str  # "eos" | "max_tokens" | "length" | "cancelled"
+    ttft_s: float  # arrival -> first token
+    latency_s: float  # arrival -> completion
+    prompt_len: int
+
+
+class RequestHandle:
+    """Client-side view of an in-flight request: a token stream plus the
+    final :class:`GenResult`. Thread-safe; one consumer per handle."""
+
+    def __init__(self, prompt_len: int):
+        self._stream: "queue.Queue[Any]" = queue.Queue()
+        self._done = threading.Event()
+        self._result: GenResult | None = None
+        self._all: list[int] = []  # engine-thread only until _finish
+        self.prompt_len = prompt_len
+
+    # engine side -----------------------------------------------------------
+    def _emit(self, token: int) -> None:
+        self._all.append(token)
+        self._stream.put(token)
+
+    def _finish(self, result: GenResult) -> None:
+        self._result = result
+        self._done.set()
+        self._stream.put(_DONE)
+
+    # client side -----------------------------------------------------------
+    def tokens(self, timeout: float | None = None) -> Iterator[int]:
+        """Stream generated tokens as they land (blocks between tokens)."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is _DONE:
+                return
+            yield item
+
+    def result(self, timeout: float | None = None) -> GenResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still in flight")
+        assert self._result is not None
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+@dataclasses.dataclass
+class Request:
+    ids: list[int]
+    max_new_tokens: int
+    handle: RequestHandle
+    arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
+
+
+@dataclasses.dataclass
+class Slot:
+    """One decode lane. ``next_pos`` is where the PENDING token will be
+    written/attended on the next decode step; ``pending`` is that token
+    (the newest generated one, already emitted to the client)."""
+
+    request: Request
+    next_pos: int  # == prompt_len right after prefill
+    pending: int
+    generated: int = 1  # prefill produced token #1
+    ttft_s: float = 0.0
+    last_token_t: float = 0.0
+
+
+class SlotTable:
+    """Fixed-size slot bookkeeping (engine-thread only, no locking)."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self.slots: list[Slot | None] = [None] * num_slots
+
+    @property
+    def active(self) -> list[tuple[int, Slot]]:
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def occupy(self, idx: int, slot: Slot) -> None:
+        assert self.slots[idx] is None, f"slot {idx} already occupied"
+        self.slots[idx] = slot
+
+    def release(self, idx: int) -> Slot:
+        slot = self.slots[idx]
+        assert slot is not None, f"slot {idx} already free"
+        self.slots[idx] = None
+        return slot

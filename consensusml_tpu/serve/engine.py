@@ -1,0 +1,496 @@
+"""The serving engine: one decode loop thread over a slot table.
+
+``Engine`` owns the three compiled program families from
+:mod:`consensusml_tpu.serve.decode`, the KV slot caches, and a single
+scheduler thread that interleaves prefill admissions with in-flight
+decode (continuous batching, :mod:`consensusml_tpu.serve.batcher`).
+Clients — the in-process API, the socket front-end, loadgen — only touch
+the bounded submit queue and per-request handles; all device work stays
+on the one engine thread, so the jit caches, the cache pytree, and the
+slot table need no locking.
+
+SLO instrumentation (docs/serving.md, docs/observability.md): every
+request path stage lands on the ``consensusml_serve_*`` metric family
+(TTFT, inter-token latency, queue depth, batch occupancy, tokens/s) and
+``serve.prefill`` / ``serve.decode_step`` spans.
+
+The steady-state contract: after :meth:`warmup` (one decode compile +
+one prefill compile per prompt bucket), serving ANY admission order of
+ANY mix of prompt lengths performs ZERO further compiles —
+:meth:`compile_counts` exposes the jit cache sizes so tests and the
+bench assert it, and cml-check's decode jaxpr contract pins the
+step-over-step program hash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+__all__ = ["ServeConfig", "Engine", "load_engine"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine geometry + admission policy (all fixed at construction —
+    shapes are compile-time)."""
+
+    num_slots: int = 8  # decode batch lanes
+    max_len: int = 0  # cache length; 0 = the model's max_len
+    queue_depth: int = 64  # bounded admission queue
+    max_new_tokens: int = 16  # default per-request generation cap
+    eos_id: int | None = None  # None: generation stops on the token cap
+    idle_wait_s: float = 0.02  # scheduler block when nothing is in flight
+
+
+class Engine:
+    """In-process serving engine over an exported consensus artifact.
+
+    ``Engine(model, params)`` then :meth:`submit` from any thread;
+    :meth:`score` is the prefill-only batch scoring path (golden parity
+    with the evaluator's consensus-mean model). Use as a context manager
+    or call :meth:`shutdown` — it drains in-flight work by default.
+    """
+
+    def __init__(self, model: Any, params: Any, config: ServeConfig | None = None):
+        import jax
+
+        from consensusml_tpu.obs import get_registry, get_tracer
+        from consensusml_tpu.serve import decode as D
+        from consensusml_tpu.serve.batcher import Request, RequestHandle, SlotTable
+
+        self.config = cfg = config or ServeConfig()
+        self._dm = dm = D.DecodeModel.wrap(model)
+        self.max_len = cfg.max_len or dm.max_len
+        if not 0 < self.max_len <= dm.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} outside (0, {dm.max_len}] "
+                "(the model's position table bounds the cache)"
+            )
+        if cfg.num_slots < 1:
+            raise ValueError(f"num_slots must be positive, got {cfg.num_slots}")
+        self.buckets = D.prefill_buckets(self.max_len)
+        self._params = jax.device_put(params)
+        self._cache = D.init_cache(dm, cfg.num_slots, self.max_len)
+        self._prefill_fn = D.make_prefill_fn(dm)
+        self._decode_fn = D.make_decode_fn(dm)
+        self._score_fn = D.make_score_fn(dm)
+        self._Request, self._RequestHandle = Request, RequestHandle
+
+        self._queue: "queue.Queue" = queue.Queue(cfg.queue_depth)
+        self._table = SlotTable(cfg.num_slots)
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+
+        self._tracer = get_tracer()
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "consensusml_serve_requests_total", "requests accepted by submit()"
+        )
+        self._m_rejected = reg.counter(
+            "consensusml_serve_rejected_total",
+            "requests rejected (bounded queue full or engine draining)",
+        )
+        self._m_completed = reg.counter(
+            "consensusml_serve_completed_total", "requests served to completion"
+        )
+        self._m_tokens = reg.counter(
+            "consensusml_serve_tokens_total", "tokens generated (prefill + decode)"
+        )
+        self._m_ttft = reg.histogram(
+            "consensusml_serve_ttft_seconds",
+            "time to first token: arrival -> first generated token",
+        )
+        self._m_intertoken = reg.histogram(
+            "consensusml_serve_intertoken_seconds",
+            "per-decode-step latency (== inter-token gap for resident slots)",
+        )
+        self._m_prefill = reg.histogram(
+            "consensusml_serve_prefill_seconds", "prefill forward wall time"
+        )
+        self._m_queue = reg.gauge(
+            "consensusml_serve_queue_depth", "requests waiting for a slot"
+        )
+        self._m_occupancy = reg.gauge(
+            "consensusml_serve_batch_occupancy",
+            "active decode slots / num_slots (sampled per step)",
+        )
+        self._m_tps = reg.gauge(
+            "consensusml_serve_tokens_per_sec",
+            "decode throughput: active slots / step wall time (sampled)",
+        )
+
+        # host-side SLO accumulators for bench/loadgen percentiles —
+        # BOUNDED rings (a serving process lives for weeks; the Prometheus
+        # histograms carry the full-lifetime distributions, these lists
+        # only feed stats() percentiles over the recent window)
+        import collections
+
+        self._ttfts: "collections.deque[float]" = collections.deque(maxlen=4096)
+        self._step_times: "collections.deque[float]" = collections.deque(
+            maxlen=4096
+        )
+        self._occupancy_sum = 0.0
+        self._decode_steps = 0
+        self._tokens_out = 0
+        self._decode_time_s = 0.0
+        self._error: BaseException | None = None
+
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(
+        self,
+        ids: Sequence[int],
+        max_new_tokens: int | None = None,
+        *,
+        block: bool = True,
+        timeout: float | None = None,
+    ):
+        """Enqueue one request; returns a ``RequestHandle``.
+
+        Raises ``queue.Full`` when the bounded queue is full (with
+        ``block=False`` or after ``timeout``) and ``RuntimeError`` once
+        the engine is draining — both count on
+        ``consensusml_serve_rejected_total``.
+        """
+        max_new = (
+            self.config.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        if self._draining.is_set() or self._stop.is_set():
+            self._m_rejected.inc()
+            if self._error is not None:
+                raise RuntimeError(
+                    f"engine died on {type(self._error).__name__}: "
+                    f"{self._error}"
+                ) from self._error
+            raise RuntimeError("engine is draining/closed; not accepting requests")
+        if len(ids) < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be positive, got {max_new}")
+        if len(ids) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(ids)}) + max_new_tokens ({max_new}) exceeds "
+                f"the cache length {self.max_len}; shorten one or build the "
+                "engine with a larger ServeConfig.max_len"
+            )
+        handle = self._RequestHandle(len(ids))
+        req = self._Request(list(map(int, ids)), max_new, handle)
+        try:
+            self._queue.put(req, block=block, timeout=timeout)
+        except queue.Full:
+            self._m_rejected.inc()
+            raise
+        if self._drained.is_set():
+            # lost the race against loop exit: the put landed after the
+            # loop's final cancellation sweep and nothing will ever
+            # service it — sweep again ourselves and refuse
+            self._cancel_queued()
+            self._m_rejected.inc()
+            raise RuntimeError(
+                "engine is draining/closed; not accepting requests"
+            )
+        self._m_requests.inc()
+        self._m_queue.set(self._queue.qsize())
+        return handle
+
+    def score(self, ids) -> Any:
+        """Prefill-only batch scoring: f32 logits ``(B, S, V)`` for a full
+        token batch — the forward is traced identically to the held-out
+        evaluator's, so an exported artifact scores BIT-EXACTLY what
+        ``evaluate()``'s mean model scores (the golden parity test)."""
+        import jax.numpy as jnp
+
+        return self._score_fn(self._params, jnp.asarray(ids, jnp.int32))
+
+    def warmup(self, buckets: Sequence[int] | None = None) -> dict[str, int]:
+        """Compile the steady-state program set: the decode step plus one
+        prefill per prompt bucket. Returns :meth:`compile_counts`.
+
+        Runs on the caller's thread against a THROWAWAY cache of the same
+        shapes (jit caches key on shape, so the executables are shared
+        with the live path) — the engine thread may already be serving,
+        and warmup must not mutate (or donate away) the cache it is
+        using. Transient cost: one extra cache's worth of memory.
+        """
+        import jax.numpy as jnp
+
+        from consensusml_tpu.serve import decode as D
+
+        cache = D.init_cache(self._dm, self.config.num_slots, self.max_len)
+        for b in buckets if buckets is not None else self.buckets:
+            ids = jnp.zeros((1, b), jnp.int32)
+            _tok, _logits, cache = self._prefill_fn(
+                self._params, cache, ids, jnp.int32(1), jnp.int32(0)
+            )
+        toks = jnp.zeros((self.config.num_slots,), jnp.int32)
+        self._decode_fn(self._params, cache, toks, jnp.zeros_like(toks))
+        return self.compile_counts()
+
+    def compile_counts(self) -> dict[str, int]:
+        """Jit-cache entry counts per program family — the
+        zero-recompile-after-warmup assertion reads this."""
+        out = {}
+        for name, fn in (
+            ("prefill", self._prefill_fn),
+            ("decode", self._decode_fn),
+            ("score", self._score_fn),
+        ):
+            size = getattr(fn, "_cache_size", None)
+            out[name] = int(size()) if size is not None else -1
+        return out
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admitting; serve everything queued + in flight to
+        completion. Returns True when fully drained (the SIGTERM path —
+        see :class:`consensusml_tpu.serve.server.ServeServer`)."""
+        self._draining.set()
+        return self._drained.wait(timeout)
+
+    def shutdown(self, drain: bool = True, timeout: float | None = None) -> None:
+        if drain:
+            self.drain(timeout)
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    def stats(self) -> dict[str, Any]:
+        """Host-side SLO summary (the bench's serving section reads this;
+        Prometheus scrapes the registry for the live families).
+        Percentiles cover the last 4096 samples; totals are lifetime."""
+        pct = lambda xs, q: (
+            float(np.percentile(list(xs), q)) if xs else float("nan")
+        )
+        decode_time = self._decode_time_s
+        return {
+            "tokens_out": self._tokens_out,
+            "decode_steps": self._decode_steps,
+            "ttft_p50_ms": 1e3 * pct(self._ttfts, 50),
+            "ttft_p99_ms": 1e3 * pct(self._ttfts, 99),
+            "intertoken_p50_ms": 1e3 * pct(self._step_times, 50),
+            "intertoken_p99_ms": 1e3 * pct(self._step_times, 99),
+            "mean_batch_occupancy": (
+                self._occupancy_sum / self._decode_steps
+                if self._decode_steps
+                else 0.0
+            ),
+            "decode_tokens_per_sec": (
+                self._tokens_out / decode_time if decode_time > 0 else 0.0
+            ),
+            "compile_counts": self.compile_counts(),
+        }
+
+    # -- engine thread ------------------------------------------------------
+
+    def _loop(self) -> None:
+        q = self._queue
+        try:
+            while not self._stop.is_set():
+                self._admit_waiting()
+                if self._table.num_active:
+                    self._decode_step()
+                    continue
+                if self._draining.is_set() and q.empty():
+                    break
+                try:
+                    req = q.get(timeout=self.config.idle_wait_s)
+                except queue.Empty:
+                    continue
+                self._m_queue.set(q.qsize())
+                self._admit(req)
+        except BaseException as e:
+            # a device error mid-serving (OOM compiling a bucket, bad
+            # params) must not leave clients parked on silent handles:
+            # mark the engine dead (submit refuses from here on), fail
+            # everything in flight, and re-raise so the thread's death is
+            # loud in logs rather than a mystery hang
+            self._error = e
+            raise
+        finally:
+            self._stop.set()
+            self._draining.set()
+            # cancel loudly: in-flight slots and queued requests get a
+            # terminal "cancelled" result instead of a hung handle
+            for i, slot in self._table.active:
+                self._table.release(i)
+                self._finish_handle(
+                    slot.request, slot.request.handle._all, "cancelled"
+                )
+            self._cancel_queued()
+            self._drained.set()
+
+    def _cancel_queued(self) -> None:
+        """Drain-and-cancel everything in the submit queue. Called by the
+        loop at exit AND by submit() when it loses the race against loop
+        exit (its put landed after the loop's final sweep) — once
+        ``_drained`` is set nothing services the queue, so cancelling is
+        always correct, and the thread-safe ``get_nowait`` hands each
+        request to exactly one canceller."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._finish_handle(req, [], "cancelled")
+
+    def _admit_waiting(self) -> None:
+        while self._table.free_slot() is not None:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._m_queue.set(self._queue.qsize())
+            self._admit(req)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt length {n} exceeds max bucket {self.buckets[-1]}")
+
+    def _admit(self, req) -> None:
+        """Prefill ``req`` into a free slot (admission = one bucketed
+        forward that seeds the slot cache and the first token). A raise
+        mid-admission cancels THIS request's handle before propagating —
+        at that point it is out of the queue but not yet in the slot
+        table, so neither of the loop's exit sweeps would reach it."""
+        try:
+            self._admit_inner(req)
+        except BaseException:
+            self._finish_handle(req, req.handle._all, "cancelled")
+            raise
+
+    def _admit_inner(self, req) -> None:
+        import jax.numpy as jnp
+
+        from consensusml_tpu.serve.batcher import Slot
+
+        idx = self._table.free_slot()
+        assert idx is not None, "admission with no free slot"
+        n = len(req.ids)
+        bucket = self._bucket(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.ids
+        t0 = time.perf_counter()
+        with self._tracer.span("serve.prefill", bucket=bucket, slot=idx):
+            tok_dev, _logits, self._cache = self._prefill_fn(
+                self._params,
+                self._cache,
+                jnp.asarray(ids),
+                jnp.int32(n),
+                jnp.int32(idx),
+            )
+            tok = int(tok_dev)  # device fence: the first token is real now
+        now = time.perf_counter()
+        self._m_prefill.observe(now - t0)
+        ttft = now - req.arrival_t
+        self._m_ttft.observe(ttft)
+        self._ttfts.append(ttft)
+        req.handle._emit(tok)
+        self._m_tokens.inc()
+        self._tokens_out += 1
+        if req.max_new_tokens == 1 or tok == self.config.eos_id:
+            reason = "eos" if tok == self.config.eos_id else "max_tokens"
+            self._finish_handle(req, req.handle._all, reason, ttft=ttft)
+            return
+        self._table.occupy(
+            idx,
+            Slot(
+                request=req, next_pos=n, pending=tok, generated=1,
+                ttft_s=ttft, last_token_t=now,
+            ),
+        )
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+
+        active = self._table.active
+        s = self.config.num_slots
+        tokens = np.zeros((s,), np.int32)
+        positions = np.zeros((s,), np.int32)
+        for i, slot in active:
+            tokens[i] = slot.pending
+            positions[i] = slot.next_pos
+        t0 = time.perf_counter()
+        with self._tracer.span("serve.decode_step", active=len(active)):
+            next_dev, self._cache = self._decode_fn(
+                self._params, self._cache, jnp.asarray(tokens), jnp.asarray(positions)
+            )
+            next_toks = np.asarray(next_dev)  # device fence per step
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        self._m_intertoken.observe(dt)
+        self._step_times.append(dt)
+        self._decode_time_s += dt
+        self._decode_steps += 1
+        self._occupancy_sum += len(active) / s
+        self._m_occupancy.set(len(active) / s)
+        if dt > 0:
+            self._m_tps.set(len(active) / dt)
+        for i, slot in active:
+            tok = int(next_toks[i])
+            slot.request.handle._emit(tok)
+            self._m_tokens.inc()
+            self._tokens_out += 1
+            slot.generated += 1
+            slot.next_pos += 1
+            slot.pending = tok
+            slot.last_token_t = now
+            reason = None
+            if tok == self.config.eos_id:
+                reason = "eos"
+            elif slot.generated >= slot.request.max_new_tokens:
+                reason = "max_tokens"
+            elif slot.next_pos >= self.max_len:
+                reason = "length"  # safety net; submit() validation bounds it
+            if reason is not None:
+                self._table.release(i)
+                self._finish_handle(
+                    slot.request, slot.request.handle._all, reason,
+                    ttft=slot.ttft_s,
+                )
+
+    def _finish_handle(self, req, tokens, reason: str, ttft: float = 0.0) -> None:
+        from consensusml_tpu.serve.batcher import GenResult
+
+        now = time.perf_counter()
+        req.handle._finish(
+            GenResult(
+                tokens=list(tokens),
+                finish_reason=reason,
+                ttft_s=ttft,
+                latency_s=now - req.arrival_t,
+                prompt_len=len(req.ids),
+            )
+        )
+        if reason != "cancelled":
+            self._m_completed.inc()
+
+
+def load_engine(path: str, config: ServeConfig | None = None) -> Engine:
+    """Build an :class:`Engine` from a serving artifact directory: the
+    meta names the config, :func:`configs.build` rebuilds the
+    architecture, and the consensus-mean params load in. Raises on
+    non-LM artifacts (only causal LMs have a decode path)."""
+    from consensusml_tpu import configs
+    from consensusml_tpu.serve.export import load_serving
+
+    meta, params, _model_state = load_serving(path)
+    bundle = configs.build(meta["config_name"], meta.get("scale", "smoke"))
+    return Engine(bundle.model, params, config)

@@ -1,0 +1,131 @@
+"""Serving artifact: the consensus-mean of a training checkpoint.
+
+A decentralized run ends with W disagreeing replicas; what you deploy is
+their consensus mean — the same model :func:`train.evaluate` scores as
+``mean_model`` and elastic grows bootstrap joiners from. The export
+collapses the stacked ``TrainState`` with the SHARED
+:func:`consensusml_tpu.utils.consensus_mean` (the serve golden parity
+test asserts export→serve logits match the eval path bit for bit) and
+writes:
+
+- ``<dir>/model/`` — orbax pytree ``{"params", "model_state"}`` with the
+  worker axis collapsed (per-worker init shapes);
+- ``<dir>/serve_meta.json`` — config name + scale (enough to rebuild the
+  architecture via :func:`consensusml_tpu.configs.build`), the training
+  round and world size the artifact came from (provenance for the
+  serving fleet's rollout logs). Written atomically, meta LAST: a
+  partial export never parses as a valid artifact.
+
+``train.py --export-serving DIR`` writes one at end of run (and at every
+``--checkpoint-every`` boundary) so training hands off to serving
+without a manual conversion step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+
+from consensusml_tpu.utils.checkpoint import replicated_scalar
+from consensusml_tpu.utils.tree import consensus_mean
+
+__all__ = ["export_serving", "load_serving", "serving_meta", "META_NAME"]
+
+META_NAME = "serve_meta.json"
+_MODEL_SUBDIR = "model"
+
+
+def _host_value(v: Any):
+    """Host numpy value of one mean leaf, shard-aware (see export)."""
+    import numpy as np
+
+    if hasattr(v, "is_fully_addressable") and not v.is_fully_addressable:
+        shard = v.addressable_shards[0]
+        if tuple(shard.data.shape) == tuple(v.shape):  # replicated
+            return np.asarray(shard.data)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.asarray(jax.device_get(v))
+
+
+def export_serving(
+    path: str,
+    state: Any,
+    *,
+    config_name: str,
+    scale: str = "smoke",
+    round: int | None = None,
+) -> str:
+    """Collapse ``state`` (stacked TrainState) to a serving artifact.
+
+    Returns the artifact directory. Safe to call repeatedly on the same
+    ``path`` (checkpoint-boundary exports overwrite: latest wins, and the
+    meta rewrite is atomic so a reader never sees a torn artifact).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    world = int(state.step.shape[0])
+    if round is None:
+        round = replicated_scalar(state.step)
+    mean = consensus_mean(
+        {"params": state.params, "model_state": state.model_state}
+    )
+    # host fetch before the write: collective-backend states are sharded
+    # over the worker mesh and the mean is tiny (1/W of the checkpoint).
+    # Multi-controller: the worker-axis mean is replicated, so any
+    # addressable shard IS the value (device_get on a cross-process
+    # array raises); non-replicated layouts allgather like evaluate.
+    mean = jax.tree.map(_host_value, mean)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return path  # one writer; peers return the same path
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, _MODEL_SUBDIR), mean, force=True)
+    meta = {
+        "config_name": config_name,
+        "scale": scale,
+        "round": int(round),
+        "world_size": world,
+    }
+    tmp = os.path.join(path, META_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, os.path.join(path, META_NAME))
+    return path
+
+
+def serving_meta(path: str) -> dict[str, Any]:
+    """The artifact's metadata dict; raises with a clear message when
+    ``path`` is not a serving artifact (meta missing/corrupt)."""
+    meta_path = os.path.join(os.path.abspath(path), META_NAME)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ValueError(
+            f"{path} is not a serving artifact ({META_NAME} unreadable: "
+            f"{e}); produce one with train.py --export-serving or "
+            "serve.export_serving()"
+        ) from None
+    if "config_name" not in meta:
+        raise ValueError(f"{meta_path} has no config_name field")
+    return meta
+
+
+def load_serving(path: str) -> tuple[dict[str, Any], Any, Any]:
+    """Load an artifact: ``(meta, params, model_state)``.
+
+    The model tree restores structurally (it was saved as a plain dict),
+    so no shape template is needed — the caller rebuilds the architecture
+    from ``meta["config_name"]`` / ``meta["scale"]``.
+    """
+    import orbax.checkpoint as ocp
+
+    meta = serving_meta(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(os.path.join(os.path.abspath(path), _MODEL_SUBDIR))
+    return meta, tree["params"], tree.get("model_state", {})

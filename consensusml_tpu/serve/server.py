@@ -1,0 +1,126 @@
+"""Threaded socket front-end over :class:`~consensusml_tpu.serve.engine.Engine`.
+
+Line-delimited JSON over TCP — deliberately minimal (no HTTP dependency
+in this environment) but shaped like a real serving front-end:
+
+request (one line)::
+
+    {"ids": [3, 17, 42], "max_new_tokens": 16}
+
+response (streamed, one line per token, then a terminal record)::
+
+    {"token": 7}
+    {"token": 19}
+    {"done": true, "tokens": [7, 19, ...], "finish_reason": "max_tokens",
+     "ttft_ms": 12.3, "latency_ms": 48.9}
+
+errors land as ``{"error": "..."}`` and close the connection. One
+request per connection keeps the protocol trivially load-generatable
+(:mod:`tools.loadgen` opens a connection per Poisson arrival, exactly
+how an L4-balanced fleet would see it).
+
+Graceful shutdown: :meth:`install_sigterm` wires SIGTERM to DRAIN —
+stop accepting, serve everything queued and in flight to completion,
+then close the listener — so a rolling restart never drops an admitted
+request.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import socket
+import threading
+from typing import Any
+
+__all__ = ["ServeServer"]
+
+
+class ServeServer:
+    """Accept loop + one thread per connection; ``port=0`` picks a free
+    port (read it back from :attr:`address`)."""
+
+    def __init__(self, engine: Any, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self._sock.settimeout(0.2)  # accept loop polls the stop flag
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._conns: set[threading.Thread] = set()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed under us during shutdown
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            self._conns.add(t)
+            t.start()
+        self._sock.close()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                f = conn.makefile("rwb")
+                line = f.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    handle = self.engine.submit(
+                        req["ids"], req.get("max_new_tokens")
+                    )
+                except Exception as e:  # bad JSON, validation, draining
+                    f.write(json.dumps({"error": str(e)}).encode() + b"\n")
+                    f.flush()
+                    return
+                for tok in handle.tokens():
+                    f.write(json.dumps({"token": int(tok)}).encode() + b"\n")
+                    f.flush()  # per-token flush IS the streaming
+                r = handle.result()
+                f.write(
+                    json.dumps(
+                        {
+                            "done": True,
+                            "tokens": r.tokens,
+                            "finish_reason": r.finish_reason,
+                            "ttft_ms": round(1e3 * r.ttft_s, 3),
+                            "latency_ms": round(1e3 * r.latency_s, 3),
+                        }
+                    ).encode()
+                    + b"\n"
+                )
+                f.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream; the engine still finishes
+        finally:
+            self._conns.discard(threading.current_thread())
+
+    def install_sigterm(self) -> None:
+        """SIGTERM (and SIGINT) => graceful drain-then-exit."""
+        def handler(signum, frame):
+            self.shutdown(drain=True)
+
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+
+    def shutdown(self, drain: bool = True, timeout: float | None = None) -> None:
+        """Stop accepting; optionally drain the engine (default) so every
+        admitted request completes before the process exits."""
+        self._stop.set()
+        self.engine.shutdown(drain=drain, timeout=timeout)
+        for t in list(self._conns):  # let response streams flush
+            t.join(timeout=2.0)
+        self._thread.join(timeout=2.0)

@@ -148,15 +148,15 @@ def make_stacked_eval_step(eval_fn: EvalFn):
         _EVAL_STEP_CACHE.move_to_end(eval_fn)
         return cached
 
+    # the SHARED consensus-mean definition (utils.tree): evaluate's mean
+    # model, elastic joiner bootstrap, and the serving export must agree
+    # bit for bit (the serve golden parity test pins eval-vs-export)
+    from consensusml_tpu.utils.tree import consensus_mean
+
     @jax.jit
     def eval_step(params, model_state, batch):
         per = jax.vmap(eval_fn, in_axes=(0, 0, None))(params, model_state, batch)
-        f32mean = lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(
-            x.dtype
-        )
-        mean_params = jax.tree.map(f32mean, params)
-        mean_state = jax.tree.map(f32mean, model_state)
-        mean = eval_fn(mean_params, mean_state, batch)
+        mean = eval_fn(consensus_mean(params), consensus_mean(model_state), batch)
         return per, mean
 
     _EVAL_STEP_CACHE[eval_fn] = eval_step

@@ -9,6 +9,7 @@ from consensusml_tpu.utils.checkpoint import (  # noqa: F401
     save_state,
 )
 from consensusml_tpu.utils.elastic import resize_state  # noqa: F401
+from consensusml_tpu.utils.tree import consensus_mean  # noqa: F401
 from consensusml_tpu.utils.logging import MetricsLogger  # noqa: F401
 from consensusml_tpu.utils.watchdog import ProgressWatchdog  # noqa: F401
 from consensusml_tpu.utils.profiling import (  # noqa: F401

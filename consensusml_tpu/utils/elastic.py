@@ -37,16 +37,9 @@ import jax.numpy as jnp
 
 from consensusml_tpu.train.local_sgd import LocalSGDConfig, TrainState, _gossiped
 from consensusml_tpu.train.outer import slowmo_init
+from consensusml_tpu.utils.tree import consensus_mean
 
 __all__ = ["resize_state"]
-
-
-def _consensus_mean(tree: Any) -> Any:
-    """Worker-mean of stacked leaves, reduced in f32, cast back."""
-    return jax.tree.map(
-        lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(x.dtype),
-        tree,
-    )
 
 
 def _take(tree: Any, n: int) -> Any:
@@ -101,8 +94,8 @@ def resize_state(
         step = state.step[:new_world]
     else:
         n_new = new_world - old_world
-        mean_p = _consensus_mean(state.params)
-        mean_ms = _consensus_mean(state.model_state)
+        mean_p = consensus_mean(state.params)
+        mean_ms = consensus_mean(state.model_state)
         params = _grow(state.params, mean_p, n_new)
         model_state = _grow(state.model_state, mean_ms, n_new)
         # joiners: fresh optimizer state on their (mean) params

@@ -1,0 +1,35 @@
+"""Tiny pytree math shared across subsystems.
+
+:func:`consensus_mean` is THE definition of "the consensus model": the
+unweighted worker-mean of a stacked (leading worker axis) pytree, reduced
+in f32 and cast back per-leaf. Three subsystems must agree on it bit for
+bit — held-out evaluation of the mean model (``train/evaluate.py``),
+elastic joiner bootstrap (``utils/elastic.py``), and the serving export
+(``serve/export.py``, whose golden parity test asserts export→serve
+logits match the eval path exactly) — so it lives here once instead of
+as three inlined tree-maps that could drift.
+
+Pure ``jnp``: safe to call inside jit (evaluate does) or eagerly on host
+trees (elastic resume, export).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["consensus_mean"]
+
+
+def consensus_mean(tree: Any) -> Any:
+    """Worker-mean over the leading stacked axis of every leaf.
+
+    Reduces in f32 (bf16 accumulation would lose low bits exactly where
+    replicas disagree least) and casts back to each leaf's dtype.
+    """
+    return jax.tree.map(
+        lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(x.dtype),
+        tree,
+    )

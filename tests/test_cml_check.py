@@ -427,6 +427,19 @@ def test_jaxpr_contracts_mnist_and_gpt2_clean():
         assert fs == [], [f.render() for f in fs]
 
 
+def test_jaxpr_decode_contracts_run_on_lm_configs_only():
+    """The serving decode step carries its own contracts (no host
+    callbacks, no f64, zero step-over-step recompiles) on the causal-LM
+    configs; non-LM configs have no decode path and are skipped."""
+    from consensusml_tpu import configs
+    from consensusml_tpu.analysis.jaxpr_contracts import _check_decode_jaxpr
+
+    for name in ("gpt2_topk", "llama_lora"):
+        fs = _check_decode_jaxpr(name, configs.build(name))
+        assert fs == [], [f.render() for f in fs]
+    assert _check_decode_jaxpr("mnist_mlp", configs.build("mnist_mlp")) == []
+
+
 def test_jaxpr_callback_detector_sees_callbacks():
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,411 @@
+"""Serving subsystem: export, KV-cache decode, continuous batching.
+
+The acceptance path (ISSUE 5): train a tiny config → ``--export-serving``
+→ the engine serves ≥8 concurrent streams through the continuous batcher
+with ZERO recompiles after warmup (compile-count AND jaxpr-asserted),
+and export→serve prefill logits are BIT-EXACT against ``evaluate()``'s
+consensus-mean eval path.
+"""
+
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu import configs
+from consensusml_tpu.serve import (
+    Engine,
+    ServeConfig,
+    ServeServer,
+    export_serving,
+    load_engine,
+    load_serving,
+    serving_meta,
+)
+from consensusml_tpu.serve.decode import prefill_buckets
+from consensusml_tpu.train import init_stacked_state
+from consensusml_tpu.utils.tree import consensus_mean
+
+pytestmark = pytest.mark.serving
+
+
+def _tiny_gpt2():
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=32, dropout=0.0
+        )
+    )
+
+
+def _init(model, seq=8, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, seq), jnp.int32))["params"]
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode correctness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_incremental_decode_matches_full_forward(family):
+    """Token-by-token decode through the slot cache reproduces the full
+    causal forward (cache write + length-masked read are exact)."""
+    if family == "gpt2":
+        model, vocab = _tiny_gpt2(), 64
+    else:
+        from consensusml_tpu.models.llama import llama_tiny
+
+        model, vocab = llama_tiny(), 256
+    B, S, T = 3, 7, 12
+    ids = jax.random.randint(jax.random.key(1), (B, S), 0, vocab)
+    params = _init(model, seq=S)
+    full = np.asarray(model.apply({"params": params}, ids, deterministic=True))
+
+    cfg = model.config
+    kvh = getattr(cfg, "kv_heads", cfg.heads)
+    d = getattr(cfg, "head_dim", cfg.hidden // cfg.heads)
+    cache = [
+        {
+            "k": jnp.zeros((B, T, kvh, d), cfg.dtype),
+            "v": jnp.zeros((B, T, kvh, d), cfg.dtype),
+        }
+        for _ in range(cfg.layers)
+    ]
+    out = []
+    for t in range(S):
+        pos = jnp.full((B,), t, jnp.int32)
+        logits, cache = model.apply(
+            {"params": params}, ids[:, t : t + 1], deterministic=True,
+            positions=pos, kv_cache=cache,
+        )
+        out.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(out, axis=1), full, atol=1e-4, rtol=1e-4)
+
+
+def test_prefill_return_kv_is_logit_neutral():
+    """return_kv must not perturb the training/eval forward."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    ids = jax.random.randint(jax.random.key(2), (2, 8), 0, 64)
+    plain = model.apply({"params": params}, ids, deterministic=True)
+    with_kv, kvs = model.apply(
+        {"params": params}, ids, deterministic=True, return_kv=True
+    )
+    assert np.array_equal(np.asarray(plain), np.asarray(with_kv))
+    assert len(kvs) == model.config.layers
+    assert kvs[0][0].shape == (2, 8, 2, 16)  # (B, S, H, D)
+
+
+def test_remat_model_still_serves():
+    """remat is a backward-pass lever; the serving forwards (return_kv /
+    kv_cache) bypass it rather than pushing python bools through
+    nn.remat's tracer boundary."""
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    model = GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=32,
+            dropout=0.0, remat=True,
+        )
+    )
+    params = _init(model)
+    ids = jax.random.randint(jax.random.key(4), (1, 8), 0, 64)
+    logits, kvs = model.apply(
+        {"params": params}, ids, deterministic=True, return_kv=True
+    )
+    assert len(kvs) == 2
+    plain = model.apply({"params": params}, ids, deterministic=True)
+    assert np.array_equal(np.asarray(plain), np.asarray(logits))
+
+
+@pytest.mark.filterwarnings(
+    # the engine thread re-raises ON PURPOSE (loud death in logs beats a
+    # mystery hang); pytest surfaces that as this warning
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_engine_death_fails_handles_loudly():
+    """A device error mid-serving must terminate handles (cancelled) and
+    turn later submits into a clear 'engine died' error — never a silent
+    hang."""
+    model = _tiny_gpt2()
+    engine = Engine(model, _init(model), ServeConfig(num_slots=2, max_len=32))
+    boom = RuntimeError("simulated device OOM")
+
+    def dying_prefill(*a, **k):
+        raise boom
+
+    engine._prefill_fn = dying_prefill
+    h = engine.submit([1, 2, 3])
+    r = h.result(timeout=30)  # not a hang
+    assert r.finish_reason == "cancelled"
+    engine._thread.join(timeout=10)
+    with pytest.raises(RuntimeError, match="engine died on RuntimeError"):
+        engine.submit([4, 5])
+
+
+def test_prefill_buckets_cover_and_cap():
+    assert prefill_buckets(32) == (8, 16, 32)
+    assert prefill_buckets(24) == (8, 16, 24)
+    assert prefill_buckets(8) == (8,)
+
+
+# ---------------------------------------------------------------------------
+# export artifact
+# ---------------------------------------------------------------------------
+
+
+def test_export_roundtrip_and_meta(tmp_path):
+    bundle = configs.build("gpt2_topk", "smoke")
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(0), bundle.world_size
+    )
+    path = export_serving(
+        str(tmp_path / "art"), state, config_name="gpt2_topk", scale="smoke"
+    )
+    meta = serving_meta(path)
+    assert meta["config_name"] == "gpt2_topk"
+    assert meta["scale"] == "smoke"
+    assert meta["world_size"] == bundle.world_size
+    assert meta["round"] == 0
+    _meta, params, model_state = load_serving(path)
+    want = jax.device_get(consensus_mean(state.params))
+    got_leaves = jax.tree.leaves(params)
+    want_leaves = jax.tree.leaves(want)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        assert np.array_equal(np.asarray(g), np.asarray(w))
+    assert model_state == {}
+
+
+def test_load_serving_rejects_non_artifact(tmp_path):
+    with pytest.raises(ValueError, match="not a serving artifact"):
+        serving_meta(str(tmp_path))
+
+
+def test_engine_rejects_non_lm_model():
+    from consensusml_tpu.models import MLP
+
+    with pytest.raises(ValueError, match="no KV-cache decode path"):
+        Engine(MLP(hidden=8), {})
+
+
+# ---------------------------------------------------------------------------
+# golden parity: export→serve (prefill-only) == evaluate's mean path
+# ---------------------------------------------------------------------------
+
+
+def test_golden_parity_export_serve_vs_evaluate_mean(tmp_path):
+    """The deployed model IS the evaluated model: logits served through
+    the engine's prefill-only scoring path match the consensus-mean eval
+    path bit for bit on the same batch."""
+    bundle = configs.build("gpt2_topk", "smoke")
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(3), bundle.world_size
+    )
+    batch = next(iter(bundle.eval_batches(1, 0)))
+    ids = batch["input_ids"]
+
+    # the eval path, exactly as make_stacked_eval_step computes the mean
+    # model: shared consensus_mean INSIDE jit over the stacked params
+    model = bundle.model
+    eval_logits = jax.jit(
+        lambda p, i: model.apply(
+            {"params": consensus_mean(p)}, i, deterministic=True
+        )
+    )(state.params, ids)
+
+    path = export_serving(
+        str(tmp_path / "art"), state, config_name="gpt2_topk", scale="smoke"
+    )
+    engine = load_engine(path, ServeConfig(num_slots=2))
+    try:
+        served = engine.score(ids)
+        assert np.array_equal(np.asarray(served), np.asarray(eval_logits)), (
+            "export→serve logits drifted from the evaluate mean path"
+        )
+    finally:
+        engine.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# continuous batcher / engine behavior
+# ---------------------------------------------------------------------------
+
+
+def test_submit_validation_and_drain_rejection():
+    model = _tiny_gpt2()
+    engine = Engine(model, _init(model), ServeConfig(num_slots=2, max_len=32))
+    try:
+        with pytest.raises(ValueError, match="empty prompt"):
+            engine.submit([])
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit([1], max_new_tokens=0)
+        with pytest.raises(ValueError, match="exceeds"):
+            engine.submit(list(range(30)), max_new_tokens=10)
+    finally:
+        engine.shutdown()
+    with pytest.raises(RuntimeError, match="draining"):
+        engine.submit([1, 2])
+
+
+def test_bounded_queue_rejects_when_full():
+    model = _tiny_gpt2()
+    # one slot + depth-1 queue, long generations: the flood must hit Full
+    engine = Engine(
+        model, _init(model),
+        ServeConfig(num_slots=1, max_len=32, queue_depth=1, max_new_tokens=24),
+    )
+    try:
+        with pytest.raises(queue.Full):
+            for _ in range(20):
+                engine.submit([1, 2, 3], block=False)
+    finally:
+        engine.shutdown(drain=False)
+
+
+def test_engine_serves_8_concurrent_streams_zero_recompiles():
+    """≥8 concurrent streams, mixed prompt lengths spanning every prefill
+    bucket, submitted from client threads — all complete via the
+    continuous batcher and the compiled-program set never grows after
+    warmup."""
+    model = _tiny_gpt2()
+    engine = Engine(
+        model, _init(model),
+        ServeConfig(num_slots=8, max_len=32, max_new_tokens=6),
+    )
+    try:
+        warm = engine.warmup()
+        assert warm["prefill"] == len(engine.buckets) and warm["decode"] == 1
+        rng = np.random.default_rng(0)
+        lens = [2, 3, 7, 8, 9, 15, 16, 17, 20, 25, 5, 11]  # every bucket
+        handles: list = [None] * len(lens)
+
+        def client(i):
+            ids = rng.integers(0, 63, size=lens[i]).tolist()
+            handles[i] = engine.submit(ids, max_new_tokens=6)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(len(lens))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = [h.result(timeout=60) for h in handles]
+        assert all(r.finish_reason == "max_tokens" for r in results)
+        assert all(len(r.tokens) == 6 for r in results)
+        stats = engine.stats()
+        assert stats["mean_batch_occupancy"] > 0.25  # actually batched
+        after = engine.compile_counts()
+        assert after["prefill"] == warm["prefill"], "prefill recompiled"
+        assert after["decode"] == warm["decode"], "decode recompiled"
+    finally:
+        engine.shutdown()
+
+
+def test_decode_is_deterministic_across_batching():
+    """A request's tokens must not depend on what shares the batch:
+    serve the same prompt alone and alongside 7 others."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    prompt = [5, 9, 2, 40, 11]
+
+    def serve_once(extra):
+        engine = Engine(
+            model, params, ServeConfig(num_slots=8, max_len=32, max_new_tokens=8)
+        )
+        try:
+            others = [
+                engine.submit([int(x) for x in np.random.default_rng(i).integers(0, 63, size=4 + i)])
+                for i in range(extra)
+            ]
+            h = engine.submit(prompt)
+            out = h.result(timeout=60).tokens
+            for o in others:
+                o.result(timeout=60)
+            return out
+        finally:
+            engine.shutdown()
+
+    assert serve_once(0) == serve_once(7)
+
+
+# ---------------------------------------------------------------------------
+# socket front-end
+# ---------------------------------------------------------------------------
+
+
+def test_socket_server_streams_and_drains():
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tools.loadgen import _socket_submit
+
+    model = _tiny_gpt2()
+    engine = Engine(
+        model, _init(model), ServeConfig(num_slots=4, max_len=32, max_new_tokens=4)
+    )
+    server = ServeServer(engine)
+    host, port = server.address
+    submit = _socket_submit(host, port)
+    rs = [submit([1, 2, 3, 4], 4) for _ in range(3)]
+    assert all(len(r["tokens"]) == 4 for r in rs)
+    assert all(r["ttft_s"] > 0 for r in rs)
+    server.shutdown(drain=True)  # graceful: everything admitted completed
+    with pytest.raises(Exception):  # listener is gone
+        submit([1, 2], 2)
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end CPU demo: train → --export-serving → serve
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_train_export_serve_demo(tmp_path):
+    """Tier-1 acceptance demo: a real (tiny) training run hands off to
+    serving through the CLI flag; the engine then serves 8+ concurrent
+    mixed-length streams with jaxpr-asserted zero decode recompiles."""
+    import train as train_cli
+
+    art = str(tmp_path / "serving")
+    rc = train_cli.main(
+        [
+            "--config", "gpt2_topk", "--device", "cpu", "--backend", "simulated",
+            "--workers", "2", "--rounds", "2", "--log-every", "1",
+            "--export-serving", art,
+        ]
+    )
+    assert rc == 0
+    meta = serving_meta(art)
+    assert meta == {
+        "config_name": "gpt2_topk", "scale": "smoke", "round": 2, "world_size": 2,
+    }
+
+    # jaxpr-asserted zero recompiles: the decode contract (step r's output
+    # cache fed back traces byte-identically) holds for the served config
+    from consensusml_tpu.analysis import jaxpr_contracts as jc
+
+    bundle = configs.build("gpt2_topk", "smoke")
+    assert jc._check_decode_jaxpr("gpt2_topk", bundle) == []
+
+    engine = load_engine(art, ServeConfig(num_slots=8, max_len=32, max_new_tokens=5))
+    try:
+        warm = engine.warmup()
+        rng = np.random.default_rng(1)
+        handles = [
+            engine.submit(rng.integers(0, 63, size=n).tolist())
+            for n in (2, 4, 6, 8, 10, 14, 16, 18, 22, 26)  # mixed buckets
+        ]
+        results = [h.result(timeout=120) for h in handles]
+        assert len(results) >= 8
+        assert all(len(r.tokens) == 5 for r in results)
+        after = engine.compile_counts()
+        assert (after["prefill"], after["decode"]) == (
+            warm["prefill"], warm["decode"],
+        ), "serving recompiled after warmup"
+        assert engine.stats()["mean_batch_occupancy"] > 0.2
+    finally:
+        engine.shutdown()

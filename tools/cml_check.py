@@ -17,7 +17,10 @@ Passes:
                 topology x wire layout (static deadlock check)
   --jaxpr       traced train-step contracts per config: no host
                 callbacks, no f64, collective count == verified
-                schedule, no round-to-round recompile
+                schedule, no round-to-round recompile; causal-LM
+                configs additionally get the SERVING decode-step
+                contracts (no host sync per token, step-over-step
+                canonical-jaxpr stability = zero decode recompiles)
   --locks       lock-discipline race lint over @guarded_by classes
 
 Exit codes: 0 clean (or everything suppressed), 1 active findings,

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Open-loop Poisson load generator for the serving engine.
+
+Drives an exported consensus artifact (in-process engine) or a running
+:class:`consensusml_tpu.serve.server.ServeServer` (socket mode) with
+open-loop traffic: arrivals follow a Poisson process at ``--rate`` req/s
+REGARDLESS of completions — the honest way to measure serving SLOs
+(closed-loop generators self-throttle and hide queueing collapse).
+Prompt lengths draw uniformly from ``--prompt-len LO:HI`` so admissions
+exercise every prefill bucket. Reports client-observed TTFT / end-to-end
+latency percentiles, goodput, and (in-process mode) the engine's own
+SLO stats, as one ``LOADGEN`` JSON line.
+
+    # in-process: load the artifact and serve it right here
+    python tools/loadgen.py --artifact /tmp/art --rate 50 --requests 200
+
+    # against a socket server (one connection per request, as an
+    # L4-balanced fleet would)
+    python tools/loadgen.py --connect 127.0.0.1:9000 --rate 50 --requests 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_loadgen(
+    submit,
+    *,
+    n_requests: int,
+    rate_rps: float,
+    prompt_lens: tuple[int, int],
+    vocab: int,
+    max_new_tokens: int,
+    seed: int = 0,
+) -> dict:
+    """Open-loop driver over any ``submit(ids, max_new) -> result_dict``
+    callable (``result_dict``: ``ttft_s``, ``latency_s``, ``tokens``).
+    Each arrival runs on its own thread so a slow request never delays
+    the next arrival (that is what makes the loop open)."""
+    rng = np.random.default_rng(seed)
+    lo, hi = prompt_lens
+    results: list[dict] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    threads = []
+
+    def one(ids):
+        try:
+            r = submit(ids, max_new_tokens)
+            with lock:
+                results.append(r)
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+    t_start = time.perf_counter()
+    for _ in range(n_requests):
+        ids = rng.integers(0, vocab - 1, size=int(rng.integers(lo, hi + 1)))
+        t = threading.Thread(target=one, args=(list(map(int, ids)),))
+        threads.append(t)
+        t.start()
+        # exponential inter-arrival gap == Poisson arrivals
+        time.sleep(float(rng.exponential(1.0 / rate_rps)))
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    pct = lambda key, q: (
+        float(np.percentile([r[key] for r in results], q)) if results else float("nan")
+    )
+    tokens_out = int(sum(len(r["tokens"]) for r in results))
+    return {
+        "requests": n_requests,
+        "completed": len(results),
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "offered_rate_rps": rate_rps,
+        "achieved_rps": len(results) / wall if wall > 0 else 0.0,
+        "tokens_out": tokens_out,
+        "tokens_per_sec": tokens_out / wall if wall > 0 else 0.0,
+        "ttft_p50_ms": 1e3 * pct("ttft_s", 50),
+        "ttft_p99_ms": 1e3 * pct("ttft_s", 99),
+        "latency_p50_ms": 1e3 * pct("latency_s", 50),
+        "latency_p99_ms": 1e3 * pct("latency_s", 99),
+        "wall_s": wall,
+    }
+
+
+def _engine_submit(engine):
+    def submit(ids, max_new):
+        h = engine.submit(ids, max_new)
+        r = h.result(timeout=300)
+        return {"ttft_s": r.ttft_s, "latency_s": r.latency_s, "tokens": r.tokens}
+
+    return submit
+
+
+def _socket_submit(host: str, port: int):
+    def submit(ids, max_new):
+        t0 = time.perf_counter()
+        with socket.create_connection((host, port), timeout=300) as conn:
+            f = conn.makefile("rwb")
+            f.write(
+                json.dumps({"ids": ids, "max_new_tokens": max_new}).encode() + b"\n"
+            )
+            f.flush()
+            ttft = None
+            tokens = []
+            for line in f:
+                msg = json.loads(line)
+                if "error" in msg:
+                    raise RuntimeError(msg["error"])
+                if msg.get("done"):
+                    return {
+                        "ttft_s": ttft if ttft is not None else 0.0,
+                        "latency_s": time.perf_counter() - t0,
+                        "tokens": msg["tokens"],
+                    }
+                if ttft is None:  # first streamed token, client-observed
+                    ttft = time.perf_counter() - t0
+                tokens.append(msg["token"])
+        raise RuntimeError("connection closed before the terminal record")
+
+    return submit
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    tgt = p.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--artifact", help="serving artifact dir (in-process engine)")
+    tgt.add_argument("--connect", help="HOST:PORT of a running ServeServer")
+    p.add_argument("--rate", type=float, default=20.0, help="Poisson arrivals/s")
+    p.add_argument("--requests", type=int, default=100)
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--prompt-len", default="4:24", metavar="LO:HI")
+    p.add_argument("--slots", type=int, default=8, help="engine slots (artifact mode)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    lo, hi = (int(x) for x in args.prompt_len.split(":"))
+    engine = None
+    if args.artifact:
+        from consensusml_tpu.serve import ServeConfig, load_engine
+
+        engine = load_engine(
+            args.artifact,
+            ServeConfig(num_slots=args.slots, max_new_tokens=args.max_new),
+        )
+        engine.warmup()
+        vocab = engine._dm.vocab_size
+        submit = _engine_submit(engine)
+    else:
+        host, _, port = args.connect.partition(":")
+        vocab = 64  # socket mode cannot introspect the model; ids stay tiny
+        submit = _socket_submit(host, int(port))
+
+    report = run_loadgen(
+        submit,
+        n_requests=args.requests,
+        rate_rps=args.rate,
+        prompt_lens=(lo, hi),
+        vocab=vocab,
+        max_new_tokens=args.max_new,
+        seed=args.seed,
+    )
+    if engine is not None:
+        report["engine"] = engine.stats()
+        engine.shutdown()
+    print("LOADGEN " + json.dumps(report), flush=True)
+    return 0 if report["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

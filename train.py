@@ -169,6 +169,14 @@ def parse_args(argv=None):
                         "(per-worker AND consensus-mean-model top-1/ppl)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0, help="rounds; 0 = end only")
+    p.add_argument("--export-serving", default=None, metavar="DIR",
+                   help="write the consensus-mean SERVING artifact here at "
+                        "end of run (and at every --checkpoint-every "
+                        "boundary when set): worker replicas collapse via "
+                        "the shared consensus mean into a deployable "
+                        "params tree + serve_meta.json that "
+                        "serve.load_engine() / tools/loadgen.py start "
+                        "from directly (docs/serving.md)")
     p.add_argument("--resume", default=None, help="checkpoint path to resume from")
     p.add_argument("--list", action="store_true", help="list configs and exit")
     return p.parse_args(argv)
@@ -810,13 +818,13 @@ def main(argv=None) -> int:
         return _train_loop(
             args, bundle, engine, wire, step, state, start, backend,
             wmesh if backend == "collective" else None,
-            logger, tracer, registry, recorder, telemetry_on,
+            logger, tracer, registry, recorder, telemetry_on, scale,
         )
 
 
 def _train_loop(
     args, bundle, engine, wire, step, state, start, backend, wmesh,
-    logger, tracer, registry, recorder, telemetry_on,
+    logger, tracer, registry, recorder, telemetry_on, scale,
 ) -> int:
     """The round loop, split out of :func:`main` so its sinks can be
     ExitStack-managed without indenting half the CLI."""
@@ -884,6 +892,22 @@ def _train_loop(
             flush=True,
         )
         return result
+
+    last_exported = None
+
+    def export_art(state, rnd):
+        # synchronous on purpose: the artifact is the consensus mean —
+        # 1/W of the checkpoint — and the train->serve handoff must be
+        # complete when the log line lands
+        nonlocal last_exported
+        from consensusml_tpu.serve.export import export_serving
+
+        path = export_serving(
+            args.export_serving, state,
+            config_name=bundle.name, scale=scale, round=rnd,
+        )
+        last_exported = rnd
+        print(f"serving artifact: {path} (round {rnd})", flush=True)
 
     batch_source = bundle.batches
     if args.native_loader:
@@ -1021,6 +1045,14 @@ def _train_loop(
             ):
                 saver.submit(args.checkpoint_dir, state, step=rnd + 1)
                 last_saved = rnd + 1
+            if (
+                args.export_serving
+                and args.checkpoint_every
+                and (rnd + 1) % args.checkpoint_every == 0
+            ):
+                # serving handoff rides the checkpoint cadence (latest
+                # wins at DIR) — a serving fleet can roll mid-run
+                export_art(state, rnd + 1)
     finally:
         # stop the prefetch thread (and close the underlying loader/
         # generator) on every exit path, including mid-run exceptions
@@ -1047,6 +1079,8 @@ def _train_loop(
     if args.checkpoint_dir:
         saver.wait()
         print(f"checkpoint: {saver.last_path}", flush=True)
+    if args.export_serving and last_exported != start + args.rounds:
+        export_art(state, start + args.rounds)
     if (
         telemetry_on
         and metrics
