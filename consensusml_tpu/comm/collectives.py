@@ -34,6 +34,36 @@ _TRACED_PPERMUTES = get_registry().counter(
     "ppermute collectives traced into gossip programs (per XLA compile)",
 )
 
+# per-EDGE trace-time wire accounting (obs.links / the cluster report's
+# bytes-per-edge view): each traced ppermute knows its payload size and —
+# via the topology's coordinate arithmetic — every directed (src, dst)
+# rank pair it moves that payload across. Worlds past this cap skip the
+# per-edge expansion (label cardinality), counted loudly instead.
+_EDGE_ACCOUNT_MAX_WORLD = 256
+
+
+def _account_edge_bytes(x, topology: Topology, shift: Shift) -> None:
+    reg = get_registry()
+    if topology.world_size > _EDGE_ACCOUNT_MAX_WORLD:
+        reg.counter(
+            "consensusml_link_unaccounted_ppermutes_total",
+            "traced ppermutes skipped by per-edge wire accounting "
+            f"(world_size > {_EDGE_ACCOUNT_MAX_WORLD})",
+        ).inc()
+        return
+    nbytes = 1
+    for d in x.shape:
+        nbytes *= int(d)
+    nbytes *= x.dtype.itemsize
+    for dst in range(topology.world_size):
+        reg.counter(
+            "consensusml_link_wire_bytes_traced_total",
+            "bytes traced onto each directed gossip edge (per XLA "
+            "compile; programs replay, so also the per-round wire "
+            "per edge)",
+            labels={"src": topology.shift_src(dst, shift), "dst": dst},
+        ).inc(nbytes)
+
 __all__ = [
     "ppermute_shift",
     "mix",
@@ -56,6 +86,7 @@ def ppermute_shift(x: jax.Array, topology: Topology, shift: Shift) -> jax.Array:
     axis_name = topology.axis_names[shift.axis]
     perm = [(s, (s + shift.offset) % n) for s in range(n)]
     _TRACED_PPERMUTES.inc()
+    _account_edge_bytes(x, topology, shift)
     with jax.named_scope("comm.ppermute"):
         return jax.lax.ppermute(x, axis_name, perm)
 

@@ -133,6 +133,17 @@ class WorkerMesh:
     def axis_names(self) -> tuple[str, ...]:
         return self.topology.axis_names
 
+    def worker_devices(self) -> list[jax.Device]:
+        """One representative device per worker rank (row-major over the
+        worker axes; the first device of each worker's model submesh) —
+        the rank -> device map the link prober (obs.links) times its
+        edge transfers across."""
+        return list(
+            np.asarray(self.mesh.devices, dtype=object).reshape(
+                self.topology.world_size, -1
+            )[:, 0]
+        )
+
     def manual_axes(self) -> frozenset[str] | None:
         """Axes ``shard_map`` should be manual over: worker axes plus any
         manual model axes (e.g. ``pp``) when a model submesh exists

@@ -12,6 +12,17 @@ Three pillars (see docs/observability.md for the full schema):
   the span ring + last-K metric snapshots to a timestamped JSON file on
   watchdog timeout, unhandled exception, or SIGTERM.
 
+The CLUSTER plane builds on them (docs/observability.md "Cluster view"):
+
+- :mod:`~consensusml_tpu.obs.links` — per-link probes feeding
+  ``consensusml_link_*`` latency/bandwidth/wire families per
+  (src, dst) edge (``train.py --link-probes``);
+- :mod:`~consensusml_tpu.obs.health` — online measured-vs-spectral-bound
+  consensus decay with sustained-anomaly detection
+  (``consensusml_health_*``);
+- :mod:`~consensusml_tpu.obs.cluster` — per-rank snapshot writer +
+  cross-rank aggregator (``--obs-cluster-dir`` + ``tools/obs_report.py``).
+
 Hot paths feed the process-wide singletons (``get_tracer()`` /
 ``get_registry()``); ``train.py`` surfaces the sinks via
 ``--trace-events`` / ``--metrics-prom`` / ``--flight-recorder`` /
@@ -20,14 +31,29 @@ Hot paths feed the process-wide singletons (``get_tracer()`` /
 the instrumentation can stay on everywhere.
 """
 
+from consensusml_tpu.obs.cluster import (  # noqa: F401
+    ClusterWriter,
+    aggregate,
+    read_snapshots,
+)
 from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
+from consensusml_tpu.obs.health import (  # noqa: F401
+    ConsensusHealthMonitor,
+    decay_bound,
+)
+from consensusml_tpu.obs.links import (  # noqa: F401
+    LinkProber,
+    link_wire_bytes,
+)
 from consensusml_tpu.obs.metrics import (  # noqa: F401
     Counter,
     DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_LINK_LATENCY_BUCKETS,
     Gauge,
     Histogram,
     MetricsRegistry,
     get_registry,
+    parse_metric_key,
 )
 from consensusml_tpu.obs.tracer import (  # noqa: F401
     SpanTracer,

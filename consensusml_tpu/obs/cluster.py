@@ -1,0 +1,413 @@
+"""Cross-rank aggregation: one cluster view from per-rank snapshots.
+
+PR 2's telemetry is strictly per-process; a swarm needs the merged
+picture. The shared-directory sideband keeps it dependency-free and
+multi-controller-correct:
+
+- every rank runs a :class:`ClusterWriter` (``train.py
+  --obs-cluster-dir DIR``): at telemetry cadence it rewrites its OWN
+  file ``obs-<role>-<rank>.json`` atomically (tmp + rename, the same
+  textfile-collector contract the Prometheus exporter uses) with its
+  current registry values, round progress, and a heartbeat timestamp.
+  One file per rank, latest wins — no append-log compaction problem,
+  no cross-process locking (ranks never touch each other's files). The
+  directory can be a shared filesystem mount (multi-host pods) or a
+  local dir that a sidecar rsyncs — the aggregator only sees files.
+- :func:`aggregate` merges every snapshot in the directory into one
+  cluster document: per-rank round/latency skew, merged per-link
+  latency histograms with a slowest-link ranking, measured-vs-bound
+  consensus health, straggler detection (stale heartbeat or round
+  lag), churn counters, and an index of any flight-recorder dumps that
+  landed next to the snapshots.
+- ``tools/obs_report.py`` renders that document as JSON or text.
+
+Non-rank roles ride the same channel: ``tools/loadgen.py
+--obs-snapshot`` writes an ``obs-loadgen-*.json`` with its
+client-observed ``consensusml_loadgen_*`` SLOs, so the serving client
+and server sides of an SLO story merge into the same report.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import time
+from typing import Any
+
+from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry, parse_metric_key
+
+__all__ = [
+    "ClusterWriter",
+    "read_snapshots",
+    "aggregate",
+    "hist_stats",
+    "SNAP_PREFIX",
+]
+
+SNAP_PREFIX = "obs-"
+
+
+class ClusterWriter:
+    """Atomically (re)writes this process's cluster snapshot file."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        rank: int = 0,
+        role: str = "rank",
+        registry: MetricsRegistry | None = None,
+        world_size: int | None = None,
+    ):
+        self.out_dir = out_dir
+        self.rank = int(rank)
+        self.role = role
+        self.world_size = world_size
+        self.registry = registry if registry is not None else get_registry()
+        os.makedirs(out_dir, exist_ok=True)
+        self.path = os.path.join(
+            out_dir, f"{SNAP_PREFIX}{role}-{self.rank:05d}.json"
+        )
+
+    def write(
+        self, round: int | None = None, extra: dict[str, Any] | None = None
+    ) -> str:
+        doc: dict[str, Any] = {
+            "rank": self.rank,
+            "role": self.role,
+            "pid": os.getpid(),
+            "world_size": self.world_size,
+            "round": round,
+            "heartbeat_s": time.time(),
+            "metrics": {
+                m.key: m.value_dict() for m in self.registry.metrics()
+            },
+        }
+        if extra:
+            doc.update(extra)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+
+def read_snapshots(cluster_dir: str) -> list[dict[str, Any]]:
+    """Every parseable ``obs-*.json`` in the directory, rank-sorted.
+    Unparseable files (a writer died mid-rename on a non-POSIX mount)
+    are reported in-band under ``_errors``, never raised."""
+    out: list[dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(cluster_dir, f"{SNAP_PREFIX}*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            doc["_file"] = os.path.basename(path)
+            out.append(doc)
+        except (OSError, ValueError) as e:
+            out.append(
+                {"_file": os.path.basename(path), "_error": f"{type(e).__name__}: {e}"}
+            )
+    out.sort(key=lambda d: (d.get("role") or "", d.get("rank") or 0))
+    return out
+
+
+def hist_stats(vd: dict[str, Any]) -> dict[str, float]:
+    """mean/p50/p99 from a histogram ``value_dict`` (cumulative-bucket
+    linear interpolation — the standard textfile-collector estimate)."""
+    count = vd.get("count", 0)
+    if not count:
+        return {"count": 0, "mean": math.nan, "p50": math.nan, "p99": math.nan}
+    total = vd.get("sum", 0.0)
+    edges = sorted(((float(le), c) for le, c in vd.get("buckets", {}).items()))
+
+    def quantile(q: float) -> float:
+        target = q * count
+        cum = 0.0
+        lo = 0.0
+        for le, c in edges:
+            if cum + c >= target:
+                frac = (target - cum) / c if c else 0.0
+                return lo + frac * (le - lo)
+            cum += c
+            lo = le
+        return lo  # landed in the +Inf bucket: report the last edge
+
+    return {
+        "count": count,
+        "mean": total / count,
+        "p50": quantile(0.50),
+        "p99": quantile(0.99),
+    }
+
+
+def _merge_hist(a: dict[str, Any] | None, b: dict[str, Any]) -> dict[str, Any]:
+    if a is None:
+        return {
+            "count": b.get("count", 0),
+            "sum": b.get("sum", 0.0),
+            "buckets": dict(b.get("buckets", {})),
+            "inf": b.get("inf", 0),
+        }
+    out = dict(a)
+    out["count"] = a.get("count", 0) + b.get("count", 0)
+    out["sum"] = a.get("sum", 0.0) + b.get("sum", 0.0)
+    out["inf"] = a.get("inf", 0) + b.get("inf", 0)
+    buckets = dict(a.get("buckets", {}))
+    for le, c in b.get("buckets", {}).items():
+        buckets[le] = buckets.get(le, 0) + c
+    out["buckets"] = buckets
+    return out
+
+
+def _metric(doc: dict, name: str, default=None):
+    v = doc.get("metrics", {}).get(name, default)
+    return default if v is None else v
+
+
+def _finite(v) -> float | None:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def aggregate(
+    cluster_dir: str,
+    *,
+    now: float | None = None,
+    straggler_age_s: float = 120.0,
+    straggler_round_lag: int = 3,
+    top_links: int = 16,
+) -> dict[str, Any]:
+    """Merge a cluster directory into one report document.
+
+    ``now`` is injectable so tests (and replays of an old directory)
+    get deterministic heartbeat ages. The report is plain JSON-able
+    data; ``tools/obs_report.py`` renders it.
+    """
+    now = time.time() if now is None else now
+    snaps = read_snapshots(cluster_dir)
+    errors = [s for s in snaps if "_error" in s]
+    ranks = [s for s in snaps if "_error" not in s and s.get("role") == "rank"]
+    others = [
+        s for s in snaps if "_error" not in s and s.get("role") != "rank"
+    ]
+
+    # ---- per-rank rows ---------------------------------------------------
+    rank_rows: list[dict[str, Any]] = []
+    link_hists: dict[tuple[str, str], dict] = {}
+    link_wire: dict[tuple[str, str], float] = {}
+    link_traced: dict[tuple[str, str], float] = {}
+    for s in ranks:
+        lat = _metric(s, "consensusml_round_latency_seconds")
+        row = {
+            "rank": s.get("rank"),
+            "file": s.get("_file"),
+            "round": s.get("round"),
+            "heartbeat_age_s": round(now - s.get("heartbeat_s", now), 3),
+            "rounds_total": _metric(s, "consensusml_rounds_total", 0.0),
+            "wire_bytes_total": _metric(s, "consensusml_wire_bytes_total", 0.0),
+            "round_latency": (
+                hist_stats(lat) if isinstance(lat, dict) else None
+            ),
+            "consensus_distance": _finite(
+                _metric(s, "consensusml_consensus_distance")
+            ),
+            "alive_frac": _finite(_metric(s, "consensusml_alive_frac")),
+            "health": {
+                "decay_measured": _finite(
+                    _metric(s, "consensusml_health_decay_measured")
+                ),
+                "decay_bound": _finite(
+                    _metric(s, "consensusml_health_decay_bound")
+                ),
+                "bound_violation": _finite(
+                    _metric(s, "consensusml_health_bound_violation")
+                ),
+                "anomalies_total": _metric(
+                    s, "consensusml_health_anomalies_total", 0.0
+                ),
+            },
+        }
+        rank_rows.append(row)
+        # merge every rank's per-edge families (a rank sees its own
+        # probes; in single-controller runs rank 0 sees every edge)
+        for key, vd in s.get("metrics", {}).items():
+            name, labels = parse_metric_key(key)
+            if "src" not in labels or "dst" not in labels:
+                continue
+            edge = (labels["src"], labels["dst"])
+            if name == "consensusml_link_latency_seconds" and isinstance(
+                vd, dict
+            ):
+                link_hists[edge] = _merge_hist(link_hists.get(edge), vd)
+            elif name in (
+                "consensusml_link_wire_bytes_per_round",
+                "consensusml_link_wire_bytes_traced_total",
+            ):
+                f = _finite(vd)
+                if f is not None:
+                    # max, not sum: every process traces/records the same
+                    # full edge set, so summing would multiply by ranks.
+                    # The two families stay SEPARATE report fields: the
+                    # gauge is the engine's per-round accounting, the
+                    # traced counter ACCUMULATES per compile (a retrace
+                    # doubles it) and must never masquerade as bytes/round
+                    tgt = (
+                        link_wire
+                        if name == "consensusml_link_wire_bytes_per_round"
+                        else link_traced
+                    )
+                    tgt[edge] = max(tgt.get(edge, 0.0), f)
+
+    # ---- skew ------------------------------------------------------------
+    rounds = [r["round"] for r in rank_rows if r["round"] is not None]
+    lat_means = [
+        r["round_latency"]["mean"]
+        for r in rank_rows
+        if r["round_latency"] and r["round_latency"]["count"]
+    ]
+    skew = {
+        "ranks": len(rank_rows),
+        "round_min": min(rounds) if rounds else None,
+        "round_max": max(rounds) if rounds else None,
+        "round_lag": (max(rounds) - min(rounds)) if rounds else None,
+        "round_latency_mean_min_s": min(lat_means) if lat_means else None,
+        "round_latency_mean_max_s": max(lat_means) if lat_means else None,
+        "round_latency_skew": (
+            max(lat_means) / min(lat_means)
+            if lat_means and min(lat_means) > 0
+            else None
+        ),
+    }
+
+    # ---- slowest links ---------------------------------------------------
+    links = []
+
+    def link_row(src: str, dst: str, st: dict | None) -> dict[str, Any]:
+        return {
+            "src": int(src),
+            "dst": int(dst),
+            "probes": st["count"] if st else 0,
+            "mean_latency_s": st["mean"] if st else None,
+            "p99_latency_s": st["p99"] if st else None,
+            "wire_bytes_per_round": link_wire.get((src, dst)),
+            "wire_bytes_traced_total": link_traced.get((src, dst)),
+        }
+
+    for (src, dst), vd in link_hists.items():
+        links.append(link_row(src, dst, hist_stats(vd)))
+    links.sort(key=lambda r: -(r["mean_latency_s"] or 0.0))
+    # edges with wire accounting but no probes still belong in the map
+    probed = {(r["src"], r["dst"]) for r in links}
+    for src, dst in sorted(set(link_wire) | set(link_traced)):
+        if (int(src), int(dst)) not in probed:
+            links.append(link_row(src, dst, None))
+
+    # ---- stragglers / churn ---------------------------------------------
+    max_round = skew["round_max"]
+    stragglers = []
+    for r in rank_rows:
+        reasons = []
+        if r["heartbeat_age_s"] > straggler_age_s:
+            reasons.append(f"heartbeat stale {r['heartbeat_age_s']:.0f}s")
+        if (
+            max_round is not None
+            and r["round"] is not None
+            and max_round - r["round"] >= straggler_round_lag
+        ):
+            reasons.append(f"{max_round - r['round']} rounds behind")
+        if reasons:
+            stragglers.append({"rank": r["rank"], "reasons": reasons})
+    churn = {
+        "elastic_resizes_total": sum(
+            _metric(s, "consensusml_elastic_resizes_total", 0.0) for s in ranks
+        ),
+        "joined_workers_total": sum(
+            _metric(s, "consensusml_elastic_joined_workers_total", 0.0)
+            for s in ranks
+        ),
+        "fault_rounds_total": sum(
+            _metric(s, "consensusml_fault_rounds_total", 0.0) for s in ranks
+        ),
+        "worker_drops_total": sum(
+            _metric(s, "consensusml_worker_drops_total", 0.0) for s in ranks
+        ),
+        "watchdog_timeouts_total": sum(
+            _metric(s, "consensusml_watchdog_timeouts_total", 0.0)
+            for s in ranks
+        ),
+    }
+
+    # ---- cluster-level health -------------------------------------------
+    measured = [
+        r["health"]["decay_measured"]
+        for r in rank_rows
+        if r["health"]["decay_measured"] is not None
+    ]
+    bounds = [
+        r["health"]["decay_bound"]
+        for r in rank_rows
+        if r["health"]["decay_bound"] is not None
+    ]
+    health = {
+        "decay_bound": bounds[0] if bounds else None,
+        "decay_measured_worst": max(measured) if measured else None,
+        "ranks_in_violation": sum(
+            1 for r in rank_rows if (r["health"]["bound_violation"] or 0) > 0
+        ),
+        "anomalies_total": sum(
+            r["health"]["anomalies_total"] or 0 for r in rank_rows
+        ),
+    }
+
+    # ---- flight-recorder index ------------------------------------------
+    flightrecs = []
+    for path in sorted(
+        glob.glob(os.path.join(cluster_dir, "**", "flightrec-*.json"),
+                  recursive=True)
+    ):
+        st = os.stat(path)
+        flightrecs.append(
+            {
+                "file": os.path.relpath(path, cluster_dir),
+                "bytes": st.st_size,
+                "mtime_s": st.st_mtime,
+            }
+        )
+
+    # ---- non-rank roles (loadgen etc.) ----------------------------------
+    other_rows = []
+    for s in others:
+        row = {
+            "role": s.get("role"),
+            "rank": s.get("rank"),
+            "file": s.get("_file"),
+            "heartbeat_age_s": round(now - s.get("heartbeat_s", now), 3),
+            "metrics": {},
+        }
+        for key, vd in s.get("metrics", {}).items():
+            if isinstance(vd, dict):
+                row["metrics"][key] = hist_stats(vd)
+            else:
+                f = _finite(vd)
+                if f is not None:
+                    row["metrics"][key] = f
+        other_rows.append(row)
+
+    return {
+        "time_s": now,
+        "cluster_dir": os.path.abspath(cluster_dir),
+        "skew": skew,
+        "ranks": rank_rows,
+        "links": links[: max(top_links, 0)] if top_links else links,
+        "links_total": len(links),
+        "health": health,
+        "stragglers": stragglers,
+        "churn": churn,
+        "flight_recorders": flightrecs,
+        "clients": other_rows,
+        "errors": errors,
+    }

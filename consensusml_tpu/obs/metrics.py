@@ -37,7 +37,9 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_registry",
+    "parse_metric_key",
     "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_LINK_LATENCY_BUCKETS",
 ]
 
 # round latencies span ~1 ms (smoke MLP on CPU) to minutes (first-round
@@ -47,22 +49,81 @@ DEFAULT_LATENCY_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
 )
 
+# link probes resolve ICI/DCN one-hop transfers: microseconds on-chip,
+# milliseconds cross-slice, seconds only when something is wrong
+DEFAULT_LINK_LATENCY_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
 _VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+
+
+def _labelstr(labels: dict[str, Any] | None) -> str:
+    """Canonical Prometheus label rendering: sorted keys, quoted values.
+    Empty/None labels render as "" so unlabeled metrics keep their bare
+    names everywhere (exposition, snapshots, registry keys)."""
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        if not k or k[0] not in _VALID_FIRST:
+            raise ValueError(f"bad label name {k!r}")
+        v = str(labels[k]).replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+_LABEL_RE = None  # compiled lazily; module import stays regex-free
+
+
+def parse_metric_key(key: str) -> tuple[str, dict[str, str]]:
+    """Invert :func:`_labelstr`: ``'m{src="0",dst="1"}'`` ->
+    ``("m", {"src": "0", "dst": "1"})``. Snapshot consumers (the cluster
+    aggregator) use this to merge labeled families across ranks.
+    Quote-aware: commas/equals INSIDE a quoted value survive the
+    round-trip (a bare split would shred them into garbage labels)."""
+    if "{" not in key:
+        return key, {}
+    global _LABEL_RE
+    if _LABEL_RE is None:
+        import re
+
+        # name="value" with \" and \\ escapes inside the quotes
+        _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    name, _, rest = key.partition("{")
+    labels: dict[str, str] = {}
+    for k, v in _LABEL_RE.findall(rest.rstrip("}")):
+        labels[k] = v.replace('\\"', '"').replace("\\\\", "\\")
+    return name, labels
 
 
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
         if not name or name[0] not in _VALID_FIRST:
             raise ValueError(f"bad metric name {name!r}")
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
+        # full identity: family name + canonical label rendering — what
+        # exposition lines, snapshot keys, and the registry key on
+        self.key = name + _labelstr(self.labels)
         # RLock, not Lock: the flight recorder's SIGTERM handler runs ON
         # the main thread and dumps the registry — with a plain lock a
         # signal landing inside a metric's critical section would
         # deadlock the handler against the very frame it interrupted
         self._lock = threading.RLock()
+
+    def _line_name(self, suffix: str = "", extra: dict | None = None) -> str:
+        """Exposition-line name: ``name<suffix>{labels...}`` with ``extra``
+        labels (a histogram's ``le``) merged after the metric's own."""
+        if extra:
+            merged = dict(self.labels)
+            merged.update(extra)
+            return f"{self.name}{suffix}{_labelstr(merged)}"
+        return f"{self.name}{suffix}{_labelstr(self.labels)}"
 
     def expose(self) -> list[str]:
         raise NotImplementedError
@@ -83,8 +144,8 @@ class Counter(_Metric):
 
     kind = "counter"
 
-    def __init__(self, name: str, help: str = ""):
-        super().__init__(name, help)
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        super().__init__(name, help, labels)
         self._value = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
@@ -100,7 +161,7 @@ class Counter(_Metric):
 
     def expose(self) -> list[str]:
         with self._lock:
-            return [f"{self.name} {_fmt(self._value)}"]
+            return [f"{self._line_name()} {_fmt(self._value)}"]
 
     def value_dict(self) -> float:
         with self._lock:
@@ -113,8 +174,8 @@ class Gauge(_Metric):
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str = ""):
-        super().__init__(name, help)
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        super().__init__(name, help, labels)
         self._value = math.nan
 
     def set(self, value: float) -> None:
@@ -132,7 +193,7 @@ class Gauge(_Metric):
 
     def expose(self) -> list[str]:
         with self._lock:
-            return [f"{self.name} {_fmt(self._value)}"]
+            return [f"{self._line_name()} {_fmt(self._value)}"]
 
     def value_dict(self) -> float:
         with self._lock:
@@ -156,8 +217,9 @@ class Histogram(_Metric):
     def __init__(
         self, name: str, help: str = "",
         buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+        labels: dict | None = None,
     ):
-        super().__init__(name, help)
+        super().__init__(name, help, labels)
         bs = sorted(float(b) for b in buckets)
         if not bs:
             raise ValueError(f"histogram {name} needs at least one bucket")
@@ -194,11 +256,13 @@ class Histogram(_Metric):
         cum = 0
         for le, c in zip(self.buckets, counts):
             cum += c
-            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {cum}')
+            lines.append(
+                f'{self._line_name("_bucket", {"le": _fmt(le)})} {cum}'
+            )
         cum += counts[-1]
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{self.name}_sum {_fmt(total)}")
-        lines.append(f"{self.name}_count {n}")
+        lines.append(f'{self._line_name("_bucket", {"le": "+Inf"})} {cum}')
+        lines.append(f'{self._line_name("_sum")} {_fmt(total)}')
+        lines.append(f'{self._line_name("_count")} {n}')
         return lines
 
     def value_dict(self) -> dict[str, Any]:
@@ -223,7 +287,7 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
-@guarded_by("_lock", "_metrics", "_snapshots")
+@guarded_by("_lock", "_metrics", "_snapshots", "_family_kinds")
 class MetricsRegistry:
     """Get-or-create metric registry with Prometheus / JSONL exporters.
 
@@ -231,20 +295,38 @@ class MetricsRegistry:
     (round metrics) and the flight recorder's crash-dump path (which
     snapshots mid-signal) — registry structures only move under
     ``_lock``; individual metric values ride each metric's own lock.
+
+    Metrics may carry Prometheus LABELS (``labels={"src": "0", ...}``):
+    each label combination is its own child metric (own lock, own
+    values), the family name keeps ONE kind across all children, and
+    exposition/snapshots key children as ``name{k="v",...}`` (see
+    :func:`parse_metric_key` for the inverse — the cluster aggregator's
+    merge path).
     """
 
     def __init__(self, snapshot_keep: int = 64):
-        self._metrics: dict[str, _Metric] = {}
+        self._metrics: dict[str, _Metric] = {}  # key (name+labels) -> metric
+        self._family_kinds: dict[str, str] = {}  # family name -> kind
         # RLock for the same signal-reentrancy reason as _Metric._lock
         self._lock = threading.RLock()
         self._snapshots: deque[dict[str, Any]] = deque(maxlen=snapshot_keep)
 
-    def _get(self, cls, name: str, help: str, **kwargs) -> _Metric:
+    def _get(
+        self, cls, name: str, help: str, labels: dict | None = None, **kwargs
+    ) -> _Metric:
+        key = name + _labelstr(labels)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = cls(name, help, **kwargs)
-                self._metrics[name] = m
+                kind = self._family_kinds.get(name)
+                if kind is not None and kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {kind}, "
+                        f"requested {cls.kind}"
+                    )
+                m = cls(name, help, labels=labels, **kwargs)
+                self._metrics[key] = m
+                self._family_kinds[name] = cls.kind
             elif not isinstance(m, cls):
                 raise ValueError(
                     f"metric {name!r} already registered as {m.kind}, "
@@ -252,17 +334,22 @@ class MetricsRegistry:
                 )
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(Counter, name, help)
+    def counter(
+        self, name: str, help: str = "", labels: dict | None = None
+    ) -> Counter:
+        return self._get(Counter, name, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(Gauge, name, help)
+    def gauge(
+        self, name: str, help: str = "", labels: dict | None = None
+    ) -> Gauge:
+        return self._get(Gauge, name, help, labels)
 
     def histogram(
         self, name: str, help: str = "",
         buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+        labels: dict | None = None,
     ) -> Histogram:
-        return self._get(Histogram, name, help, buckets=buckets)
+        return self._get(Histogram, name, help, labels, buckets=buckets)
 
     def metrics(self) -> list[_Metric]:
         with self._lock:
@@ -271,10 +358,20 @@ class MetricsRegistry:
     # -- Prometheus exporter ----------------------------------------------
     def to_prometheus(self) -> str:
         lines: list[str] = []
-        for m in sorted(self.metrics(), key=lambda m: m.name):
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+        last_family = None
+        # sort by (family, labels): one HELP/TYPE header per family, its
+        # labeled children grouped under it
+        ms = sorted(self.metrics(), key=lambda m: (m.name, m.key))
+        helps: dict[str, str] = {}
+        for m in ms:  # any child may carry the family help string
+            if m.help and m.name not in helps:
+                helps[m.name] = m.help
+        for m in ms:
+            if m.name != last_family:
+                if m.name in helps:
+                    lines.append(f"# HELP {m.name} {helps[m.name]}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                last_family = m.name
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
@@ -294,7 +391,7 @@ class MetricsRegistry:
         snap: dict[str, Any] = {"time_s": time.time()}
         if extra:
             snap.update(extra)
-        snap["metrics"] = {m.name: m.value_dict() for m in self.metrics()}
+        snap["metrics"] = {m.key: m.value_dict() for m in self.metrics()}
         with self._lock:
             self._snapshots.append(snap)
         return snap

@@ -89,16 +89,40 @@ class Topology:
     def rank(self, coords: Sequence[int]) -> int:
         return int(np.ravel_multi_index(tuple(coords), self.mesh_shape, mode="wrap"))
 
+    def shift_src(self, rank: int, shift: Shift) -> int:
+        """The rank whose value ``rank`` RECEIVES under ``shift`` — the
+        one inverse-shift definition every consumer shares (mixing-matrix
+        construction here, per-edge wire accounting in comm/collectives,
+        probe edge sets in obs.links): a drifted copy would silently
+        attribute bytes or probes to the wrong link."""
+        src = list(self.coords(rank))
+        src[shift.axis] = (src[shift.axis] - shift.offset) % self.mesh_shape[
+            shift.axis
+        ]
+        return self.rank(src)
+
     def neighbors(self, rank: int) -> list[tuple[int, float]]:
         """(neighbor_rank, weight) pairs worker ``rank`` receives from."""
         out: dict[int, float] = {}
-        c = self.coords(rank)
         for s in self.shifts:
-            src = list(c)
-            src[s.axis] = (src[s.axis] - s.offset) % self.mesh_shape[s.axis]
-            r = self.rank(src)
+            r = self.shift_src(rank, s)
             out[r] = out.get(r, 0.0) + s.weight
         return sorted(out.items())
+
+    def edges(self) -> list[tuple[int, int, float]]:
+        """Directed wire edges ``(src, dst, weight)``: ``dst`` receives
+        ``src``'s value with this mixing weight. Built from the same
+        shift arithmetic as :meth:`neighbors`, so it names exactly the
+        links one gossip round moves payloads across — the per-link
+        probe / cluster-report edge set (obs.links). Parallel shifts
+        onto the same edge merge (weights add), matching the mixing
+        matrix. Self-loops are omitted: they are not wire."""
+        out: list[tuple[int, int, float]] = []
+        for dst in range(self.world_size):
+            for src, w in self.neighbors(dst):
+                if src != dst:
+                    out.append((src, dst, w))
+        return out
 
     # ---- mixing matrix --------------------------------------------------
     def mixing_matrix(self) -> np.ndarray:
@@ -327,6 +351,17 @@ class TimeVaryingTopology(Topology):
     @property
     def period(self) -> int:
         return len(self.phases)
+
+    def edges(self) -> list[tuple[int, int, float]]:
+        """Union of every phase's edges, weights averaged over the
+        period (an edge used 1-in-K rounds reports weight/K) — the
+        per-ROUND expected wire, matching ``_sends_per_round``'s
+        per-period averaging."""
+        acc: dict[tuple[int, int], float] = {}
+        for p in self.phases:
+            for src, dst, w in p.edges():
+                acc[(src, dst)] = acc.get((src, dst), 0.0) + w / self.period
+        return [(s, d, w) for (s, d), w in sorted(acc.items())]
 
     def phase_matrices(self) -> np.ndarray:
         """``(period, n, n)`` stacked per-phase mixing matrices."""

@@ -28,6 +28,46 @@ __all__ = [
 ]
 
 
+def _is_key(x: Any) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    )
+
+
+def _unwrap_keys(tree: Any) -> Any:
+    """Typed PRNG-key leaves -> their raw uint32 key data.
+
+    orbax (0.7 on this box) cannot serialize typed key arrays at all:
+    its shard serializer calls ``np.array(shard.data)``, which
+    ``PRNGKeyArray.__array__`` refuses — the root cause of the
+    long-standing ``--checkpoint-dir`` + ``--checkpoint-every`` crash
+    (and of end-of-run saves of any state holding typed keys). Keys ride
+    the checkpoint as data; :func:`restore_state` re-wraps them from the
+    template's impl.
+    """
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree
+    )
+
+
+def _rewrap_keys(tree: Any, like: Any) -> Any:
+    """Inverse of :func:`_unwrap_keys`: where ``like`` holds a typed key,
+    wrap the restored uint32 data back with the template's impl."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda r, l: (
+            jax.random.wrap_key_data(
+                jnp.asarray(r), impl=jax.random.key_impl(l)
+            )
+            if _is_key(l)
+            else r
+        ),
+        tree,
+        like,
+    )
+
+
 def save_state(path: str, state: Any, step: int | None = None) -> str:
     """Write a checkpoint at ``path`` (optionally ``path/step_N``).
 
@@ -35,12 +75,14 @@ def save_state(path: str, state: Any, step: int | None = None) -> str:
     size (leading axis of ``state.step`` when present), which lets
     elastic resume (``utils.elastic``) rebuild the right-sized restore
     template without the caller knowing the original worker count.
+    Typed PRNG-key leaves are stored as raw key data (orbax cannot
+    serialize key arrays — see :func:`_unwrap_keys`).
     """
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
+        ckptr.save(path, _unwrap_keys(state), force=True)
     step_leaf = getattr(state, "step", None)
     if step_leaf is not None and getattr(step_leaf, "ndim", 0) == 1:
         # atomic write: a preemption mid-write must leave either no meta
@@ -99,7 +141,7 @@ class AsyncSaver:
         if jax.process_count() > 1:
             self.last_path = save_state(path, state, step=step)
             return
-        snapshot = jax.device_get(state)
+        snapshot = _host_snapshot(state)
 
         def write():
             try:
@@ -120,6 +162,43 @@ class AsyncSaver:
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError(f"async checkpoint write failed: {err!r}") from err
+
+
+def _host_snapshot(state: Any) -> Any:
+    """Host copy of ``state`` for a background orbax write.
+
+    Plain leaves fetch to numpy (one batched transfer — the only
+    device-blocking part of an async save). Typed PRNG-key leaves must
+    STAY jax Arrays: ``device_get`` hands back a key array whose base is
+    a raw numpy ndarray, and orbax's ArrayHandler then crashes walking
+    ``.addressable_shards`` on it (the long-standing --checkpoint-every
+    background-write failure). They also must be REAL COPIES — a
+    same-device ``device_put`` aliases the training buffer, which the
+    next donated train step deletes out from under the background write
+    — so the key data round-trips through host numpy and re-wraps.
+    """
+    keys, others = [], []
+    flat, treedef = jax.tree_util.tree_flatten(state)
+    for x in flat:
+        (keys if _is_key(x) else others).append(x)
+    fetched = iter(jax.device_get(others))
+    import jax.numpy as jnp
+
+    moved = iter(
+        [
+            # jnp.asarray, NOT the raw numpy: wrap_key_data keeps
+            # whatever base it is handed, and a numpy-backed key array
+            # reproduces the exact ArrayHandler crash being fixed
+            jax.random.wrap_key_data(
+                jnp.asarray(jax.device_get(jax.random.key_data(k))),
+                impl=jax.random.key_impl(k),
+            )
+            for k in keys
+        ]
+    )
+    return jax.tree_util.tree_unflatten(
+        treedef, [next(moved) if _is_key(x) else next(fetched) for x in flat]
+    )
 
 
 def _meta_int(path: str, key: str) -> int | None:
@@ -159,8 +238,12 @@ def restore_state(path: str, like: Any) -> Any:
     optimizer state, rng and step restore exactly.
     """
     path = os.path.abspath(path)
+    # keys restore as raw uint32 data (see _unwrap_keys) and re-wrap at
+    # the end from the template's impl
+    key_template = like
+    like = _unwrap_keys(like)
     try:
-        return _restore(path, like)
+        return _rewrap_keys(_restore(path, like), key_template)
     except ValueError as e:
         # The drift test is STRUCTURAL, not a match on orbax's error text
         # (ADVICE r4: message wording changes across orbax versions): if
@@ -190,7 +273,9 @@ def restore_state(path: str, like: Any) -> Any:
             "the next few rounds, everything else restored exactly",
             stacklevel=2,
         )
-        return restored._replace(gossip=like.gossip)
+        return _rewrap_keys(
+            restored._replace(gossip=like.gossip), key_template
+        )
 
 
 def _restore(path: str, like: Any) -> Any:

@@ -85,6 +85,11 @@ def resize_state(
         "consensusml_elastic_joined_workers_total",
         "workers bootstrapped from the consensus mean by elastic grows",
     ).inc(max(0, new_world - old_world))
+    get_registry().gauge(
+        "consensusml_elastic_world_size",
+        "stacked world size after the latest elastic resize "
+        "(cluster-view membership)",
+    ).set(new_world)
 
     if new_world < old_world:
         params = _take(state.params, new_world)

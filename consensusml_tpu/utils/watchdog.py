@@ -90,6 +90,16 @@ class ProgressWatchdog:
             self._last = time.monotonic()
             self._tag = tag
             self._armed = True
+        # cluster-view liveness: the beat doubles as this rank's
+        # heartbeat counter (obs.cluster reads staleness off the
+        # heartbeat gauge the train loop sets; the counter tells a
+        # straggler apart from a rank whose watchdog is simply off)
+        from consensusml_tpu.obs import get_registry
+
+        get_registry().counter(
+            "consensusml_watchdog_beats_total",
+            "watchdog progress beats (one per completed unit of work)",
+        ).inc()
 
     def pause(self) -> None:
         """Suspend deadline enforcement until the next :meth:`beat` —

@@ -172,6 +172,40 @@ def test_flight_recorder_dump_contains_spans_and_snapshots(tmp_path):
     assert doc["metrics_final"]["metrics"]["t_rounds_total"] == 7
 
 
+def test_flight_recorder_sigterm_dump_chains(tmp_path):
+    """The SIGTERM trigger (launcher preemption): the dump lands and the
+    PREVIOUS handler still runs. A benign handler is installed first so
+    the chained default disposition never kills pytest."""
+    import os as _os
+    import signal
+    import sys
+    import time as _time
+
+    t, r = SpanTracer(), MetricsRegistry()
+    with t.span("gossip.round"):
+        pass
+    r.counter("t_rounds_total").inc(2)
+    rec = FlightRecorder(str(tmp_path / "fr"), tracer=t, registry=r)
+    seen = []
+    prev_sig = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    prev_hook = sys.excepthook
+    try:
+        rec.install(sigterm=True)
+        _os.kill(_os.getpid(), signal.SIGTERM)
+        deadline = _time.monotonic() + 10.0
+        while not seen and _time.monotonic() < deadline:
+            _time.sleep(0.01)  # signal delivery is between bytecodes
+    finally:
+        signal.signal(signal.SIGTERM, prev_sig)
+        sys.excepthook = prev_hook
+    assert seen == [signal.SIGTERM]  # the chained handler ran
+    assert rec.last_dump_path and os.path.exists(rec.last_dump_path)
+    doc = json.load(open(rec.last_dump_path))
+    assert doc["reason"] == "sigterm"
+    assert [s["name"] for s in doc["spans"]] == ["gossip.round"]
+    assert doc["metrics_final"]["metrics"]["t_rounds_total"] == 2
+
+
 def test_flight_recorder_excepthook_chains(tmp_path):
     import sys
 

@@ -11,6 +11,12 @@ exercise every prefill bucket. Reports client-observed TTFT / end-to-end
 latency percentiles, goodput, and (in-process mode) the engine's own
 SLO stats, as one ``LOADGEN`` JSON line.
 
+``--obs-snapshot DIR`` additionally writes the client-observed SLOs as
+a ``consensusml_loadgen_*`` metrics snapshot (``obs-loadgen-<seed>.json``,
+the same registry format every rank writes under ``--obs-cluster-dir``),
+so the serving CLIENT side and the engine's ``consensusml_serve_*``
+SERVER side merge into one ``tools/obs_report.py`` report.
+
     # in-process: load the artifact and serve it right here
     python tools/loadgen.py --artifact /tmp/art --rate 50 --requests 200
 
@@ -80,6 +86,7 @@ def run_loadgen(
         float(np.percentile([r[key] for r in results], q)) if results else float("nan")
     )
     tokens_out = int(sum(len(r["tokens"]) for r in results))
+    _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall)
     return {
         "requests": n_requests,
         "completed": len(results),
@@ -95,6 +102,53 @@ def run_loadgen(
         "latency_p99_ms": 1e3 * pct("latency_s", 99),
         "wall_s": wall,
     }
+
+
+def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
+    """Feed the run into the process registry as the
+    ``consensusml_loadgen_*`` family — the client-observed half of the
+    serving SLO story, in the same registry/snapshot format the server
+    side exports (docs/observability.md)."""
+    from consensusml_tpu.obs import get_registry
+
+    reg = get_registry()
+    # sub-second SLO work: finer buckets than the round-latency default
+    slo_buckets = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0, 30.0,
+    )
+    ttft = reg.histogram(
+        "consensusml_loadgen_ttft_seconds",
+        "client-observed time to first token", buckets=slo_buckets,
+    )
+    lat = reg.histogram(
+        "consensusml_loadgen_latency_seconds",
+        "client-observed end-to-end request latency", buckets=slo_buckets,
+    )
+    for r in results:
+        ttft.observe(r["ttft_s"])
+        lat.observe(r["latency_s"])
+    reg.counter(
+        "consensusml_loadgen_requests_total", "requests issued"
+    ).inc(n_requests)
+    reg.counter(
+        "consensusml_loadgen_completed_total", "requests completed"
+    ).inc(len(results))
+    reg.counter(
+        "consensusml_loadgen_errors_total", "requests that errored"
+    ).inc(len(errors))
+    reg.counter(
+        "consensusml_loadgen_tokens_total", "tokens received"
+    ).inc(tokens_out)
+    reg.gauge(
+        "consensusml_loadgen_offered_rate_rps", "Poisson arrival rate"
+    ).set(rate_rps)
+    reg.gauge(
+        "consensusml_loadgen_achieved_rps", "completions per wall second"
+    ).set(len(results) / wall if wall > 0 else 0.0)
+    reg.gauge(
+        "consensusml_loadgen_tokens_per_sec", "token goodput"
+    ).set(tokens_out / wall if wall > 0 else 0.0)
 
 
 def _engine_submit(engine):
@@ -146,6 +200,12 @@ def main(argv=None) -> int:
     p.add_argument("--prompt-len", default="4:24", metavar="LO:HI")
     p.add_argument("--slots", type=int, default=8, help="engine slots (artifact mode)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--obs-snapshot", default=None, metavar="DIR",
+                   help="write the consensusml_loadgen_* metrics snapshot "
+                        "to DIR (obs-loadgen-<seed>.json, cluster snapshot "
+                        "format) — point it at the serving side's "
+                        "--obs-cluster-dir and tools/obs_report.py shows "
+                        "client + server SLOs in one report")
     args = p.parse_args(argv)
 
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
@@ -177,6 +237,13 @@ def main(argv=None) -> int:
     if engine is not None:
         report["engine"] = engine.stats()
         engine.shutdown()
+    if args.obs_snapshot:
+        from consensusml_tpu.obs import ClusterWriter
+
+        path = ClusterWriter(
+            args.obs_snapshot, rank=args.seed, role="loadgen"
+        ).write(extra={"report": report})
+        print(f"obs snapshot: {path}", flush=True)
     print("LOADGEN " + json.dumps(report), flush=True)
     return 0 if report["errors"] == 0 else 1
 

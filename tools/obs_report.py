@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Render a cluster observability report from an --obs-cluster-dir.
+
+Merges every rank's ``obs-*.json`` snapshot (and any loadgen/client
+snapshots and flight-recorder dumps living in the same directory) into
+one report: per-rank round/latency skew, slowest-link ranking with the
+bytes each edge carries, measured-vs-bound consensus health, straggler
+detection, and churn counters. See docs/observability.md "Cluster view".
+
+    python tools/obs_report.py /shared/obs            # text report
+    python tools/obs_report.py /shared/obs --json     # full JSON doc
+    python tools/obs_report.py /shared/obs --top 8    # top-8 links only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_s(v) -> str:
+    if v is None or (isinstance(v, float) and not math.isfinite(v)):
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def _fmt_b(v) -> str:
+    if v is None:
+        return "-"
+    for unit, scale in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if v >= scale:
+            return f"{v / scale:.2f}{unit}"
+    return f"{v:.0f}B"
+
+
+def render_text(doc: dict) -> str:
+    lines: list[str] = []
+    add = lines.append
+    skew = doc["skew"]
+    add(f"cluster report: {doc['cluster_dir']}")
+    add(
+        f"ranks={skew['ranks']} rounds [{skew['round_min']}, "
+        f"{skew['round_max']}] lag={skew['round_lag']} "
+        f"latency skew={skew['round_latency_skew'] and round(skew['round_latency_skew'], 3)}"
+    )
+    add("")
+    add("rank  round  age      lat(mean/p99)        consensus  decay(meas/bound)  viol")
+    for r in doc["ranks"]:
+        lat = r["round_latency"]
+        h = r["health"]
+        add(
+            f"{r['rank']:>4}  {str(r['round']):>5}  "
+            f"{r['heartbeat_age_s']:>6.1f}s  "
+            f"{_fmt_s(lat and lat['mean']):>9}/{_fmt_s(lat and lat['p99']):<9}  "
+            f"{'-' if r['consensus_distance'] is None else format(r['consensus_distance'], '.4g'):>9}  "
+            f"{'-' if h['decay_measured'] is None else format(h['decay_measured'], '.4f'):>8}/"
+            f"{'-' if h['decay_bound'] is None else format(h['decay_bound'], '.4f'):<8}  "
+            f"{int(h['bound_violation'] or 0)}"
+        )
+    if doc["links"]:
+        add("")
+        add(f"links (slowest first; {doc['links_total']} total):")
+        add("  src->dst   probes  mean       p99        bytes/round")
+        for l in doc["links"]:
+            add(
+                f"  {l['src']:>3}->{l['dst']:<3}  {l['probes']:>6}  "
+                f"{_fmt_s(l['mean_latency_s']):>9}  "
+                f"{_fmt_s(l['p99_latency_s']):>9}  "
+                f"{_fmt_b(l['wire_bytes_per_round']):>10}"
+            )
+    h = doc["health"]
+    add("")
+    add(
+        f"health: bound={h['decay_bound']} worst measured="
+        f"{h['decay_measured_worst']} ranks_in_violation="
+        f"{h['ranks_in_violation']} anomalies={h['anomalies_total']}"
+    )
+    if doc["stragglers"]:
+        add("stragglers:")
+        for s in doc["stragglers"]:
+            add(f"  rank {s['rank']}: {'; '.join(s['reasons'])}")
+    else:
+        add("stragglers: none")
+    c = doc["churn"]
+    add(
+        f"churn: resizes={c['elastic_resizes_total']:.0f} "
+        f"joins={c['joined_workers_total']:.0f} "
+        f"fault_rounds={c['fault_rounds_total']:.0f} "
+        f"drops={c['worker_drops_total']:.2f} "
+        f"watchdog_timeouts={c['watchdog_timeouts_total']:.0f}"
+    )
+    if doc["flight_recorders"]:
+        add("flight recorders:")
+        for fr in doc["flight_recorders"]:
+            add(f"  {fr['file']} ({fr['bytes']}B)")
+    for cl in doc["clients"]:
+        add(f"client [{cl['role']}-{cl['rank']}]:")
+        for k, v in sorted(cl["metrics"].items()):
+            if isinstance(v, dict):
+                add(
+                    f"  {k}: mean={_fmt_s(v['mean'])} p50={_fmt_s(v['p50'])} "
+                    f"p99={_fmt_s(v['p99'])} n={v['count']}"
+                )
+            else:
+                add(f"  {k}: {v:g}")
+    for e in doc["errors"]:
+        add(f"unreadable snapshot: {e['_file']}: {e['_error']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("cluster_dir", help="the --obs-cluster-dir to aggregate")
+    p.add_argument("--json", action="store_true", help="emit the full JSON doc")
+    p.add_argument("--top", type=int, default=16, help="link-ranking depth (0 = all)")
+    p.add_argument("--straggler-age", type=float, default=120.0,
+                   help="heartbeat staleness (s) that flags a straggler")
+    p.add_argument("--straggler-lag", type=int, default=3,
+                   help="round lag that flags a straggler")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.cluster_dir):
+        print(f"error: {args.cluster_dir} does not exist or is not a "
+              "directory (pass the --obs-cluster-dir of a run)",
+              file=sys.stderr)
+        return 1
+    from consensusml_tpu.obs.cluster import aggregate
+
+    doc = aggregate(
+        args.cluster_dir,
+        straggler_age_s=args.straggler_age,
+        straggler_round_lag=args.straggler_lag,
+        top_links=args.top,
+    )
+    if not doc["ranks"] and not doc["clients"]:
+        print(
+            f"error: no obs-*.json snapshots under {args.cluster_dir} "
+            "(run train.py with --obs-cluster-dir pointing here)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(render_text(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ import argparse
 import contextlib
 import os
 import sys
+import time
 
 
 def parse_args(argv=None):
@@ -161,6 +162,22 @@ def parse_args(argv=None):
                         "timeout, unhandled exception, or SIGTERM, dump the "
                         "last rounds' spans + metric snapshots to a "
                         "timestamped JSON file in DIR")
+    p.add_argument("--obs-cluster-dir", default=None, metavar="DIR",
+                   help="cluster observability sideband: atomically rewrite "
+                        "this rank's obs-rank-N.json snapshot (registry "
+                        "values, round progress, heartbeat) in DIR at "
+                        "--telemetry-every cadence; point every rank of a "
+                        "swarm at one shared DIR and render the merged view "
+                        "with tools/obs_report.py (docs/observability.md "
+                        "'Cluster view')")
+    p.add_argument("--link-probes", action="store_true",
+                   help="probe per-link latency/bandwidth: at "
+                        "--telemetry-every cadence, time one small transfer "
+                        "across every directed gossip edge and export the "
+                        "consensusml_link_* families per (src, dst) — the "
+                        "slowest-link ranking the cluster report and the "
+                        "topology auto-tuner consume (host-side sideband, "
+                        "never inside the jitted round)")
     p.add_argument("--eval-every", type=int, default=0,
                    help="also run the held-out eval every K rounds during "
                         "training (requires --eval-batches)")
@@ -632,7 +649,11 @@ def main(argv=None) -> int:
     tracer = get_tracer()
     registry = get_registry()
     telemetry_on = bool(
-        args.trace_events or args.metrics_prom or args.flight_recorder
+        args.trace_events
+        or args.metrics_prom
+        or args.flight_recorder
+        or args.obs_cluster_dir
+        or args.link_probes
     )
     if telemetry_on:
         # host span recording on; without any sink the tracer stays
@@ -819,12 +840,14 @@ def main(argv=None) -> int:
             args, bundle, engine, wire, step, state, start, backend,
             wmesh if backend == "collective" else None,
             logger, tracer, registry, recorder, telemetry_on, scale,
+            param_shapes,
         )
 
 
 def _train_loop(
     args, bundle, engine, wire, step, state, start, backend, wmesh,
     logger, tracer, registry, recorder, telemetry_on, scale,
+    param_shapes,
 ) -> int:
     """The round loop, split out of :func:`main` so its sinks can be
     ExitStack-managed without indenting half the CLI."""
@@ -859,10 +882,57 @@ def _train_loop(
         "consensusml_round_latency_seconds",
         "wall time of one full training round (inner loop + gossip)",
     )
+    m_heartbeat = registry.gauge(
+        "consensusml_heartbeat_time_seconds",
+        "unix time of this rank's latest completed round (cluster-view "
+        "liveness; staleness flags a straggler)",
+    )
+    m_progress = registry.gauge(
+        "consensusml_round_progress",
+        "this rank's latest completed round index (cluster-view skew)",
+    )
+
+    # ---- cluster observability plane (obs.health/links/cluster) ---------
+    from consensusml_tpu.obs import (
+        ClusterWriter,
+        ConsensusHealthMonitor,
+        LinkProber,
+    )
+
+    # always on: a few float stores per round, and sustained divergence
+    # should be loud even when no sink is configured
+    health = ConsensusHealthMonitor(engine.topology, registry=registry)
+    prober = None
+    if args.link_probes:
+        prober = LinkProber(
+            engine.topology,
+            registry=registry,
+            devices=wmesh.worker_devices() if wmesh is not None else None,
+        )
+        # per-edge steady-state wire gauges from the engine accounting
+        # (param_shapes: main's eval_shape output, computed once)
+        prober.record_wire_rates(engine, param_shapes)
+        print(
+            f"link probes armed: {len(prober.edges)} edges "
+            f"({prober.payload_bytes} B payload)",
+            flush=True,
+        )
+    cluster = None
+    if args.obs_cluster_dir:
+        cluster = ClusterWriter(
+            args.obs_cluster_dir,
+            rank=jax.process_index(),
+            registry=registry,
+            world_size=bundle.world_size,
+        )
+        print(f"cluster snapshots: {cluster.path}", flush=True)
 
     def telemetry_tick(rnd, state):
         """The heavier sampled telemetry (--telemetry-every cadence):
-        CHOCO residual fetch, metric snapshot, Prometheus rewrite."""
+        link probes, CHOCO residual fetch, metric snapshot, Prometheus
+        rewrite, cluster snapshot."""
+        if prober is not None:
+            prober.probe_round()
         resid = engine.choco_residual(state.gossip)
         if resid is not None:
             registry.gauge(
@@ -872,6 +942,8 @@ def _train_loop(
         registry.snapshot({"round": rnd})
         if args.metrics_prom:
             registry.write_prometheus(args.metrics_prom)
+        if cluster is not None:
+            cluster.write(round=rnd)
 
     def run_eval(state, rnd):
         # evaluate() caches its jitted step per eval_fn, so periodic
@@ -1000,11 +1072,17 @@ def _train_loop(
             m_rounds.inc()
             m_wire_total.inc(wire)
             m_latency.observe(timer.last_lap_s)
+            m_heartbeat.set(time.time())
+            m_progress.set(rnd)
             if "consensus_error" in metrics:
+                cdist = float(metrics["consensus_error"])
                 registry.gauge(
                     "consensusml_consensus_distance",
                     "post-gossip consensus distance sqrt(mean_i ||x_i - xbar||^2)",
-                ).set(float(metrics["consensus_error"]))
+                ).set(cdist)
+                # measured-decay-vs-spectral-bound check; loud on
+                # sustained divergence (obs.health)
+                health.observe(rnd, cdist)
             registry.gauge(
                 "consensusml_round_stall_seconds",
                 "host wait at the round's execution fence (overlap headroom)",
@@ -1091,6 +1169,10 @@ def _train_loop(
         # final sample so short runs (< --telemetry-every rounds) still
         # land a snapshot; the ExitStack writes the prom/trace files
         telemetry_tick(start + args.rounds - 1, state)
+    elif cluster is not None:
+        # cadence just ticked: the snapshot is current, but refresh the
+        # heartbeat so the cluster view sees a clean exit
+        cluster.write(round=start + args.rounds - 1)
     if metrics:
         print(f"timing: {timer.stats().format()}", flush=True)
         print(

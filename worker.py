@@ -15,6 +15,12 @@ Example (2 hosts):
                --process-id 0 -- --config cifar_resnet50 --device tpu
     host1$ python worker.py --coordinator 10.0.0.1:8476 --num-processes 2 \
                --process-id 1 -- --config cifar_resnet50 --device tpu
+
+Cluster observability: forward ``--obs-cluster-dir DIR`` (a shared
+mount) and every process writes its own ``obs-rank-<process_index>.json``
+snapshot there — ``tools/obs_report.py DIR`` then renders the merged
+swarm view (per-rank skew, slowest links, consensus health; see
+docs/observability.md "Cluster view").
 """
 
 from __future__ import annotations
