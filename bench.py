@@ -955,6 +955,112 @@ def _obs_bench() -> dict:
     }
 
 
+def _elastic_bench() -> dict:
+    """Elastic-swarm section: what live membership churn costs.
+
+    Runs the deterministic churn harness (consensusml_tpu.swarm) twice on
+    the simulated backend at MLP scale, equal data: once churn-free, once
+    under a seeded schedule (joins + drops + a straggler). Reports the
+    recovery-round cost — wall time of a gossip bootstrap (the join
+    price, replacing a checkpoint read + restart) vs one training round —
+    and the loss-continuity delta between the two runs' final losses,
+    plus the bootstrapped joiners' measured epsilon vs the consensus
+    mean."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    jax.config.update("jax_platforms", "cpu")
+    from consensusml_tpu.consensus import GossipConfig
+    from consensusml_tpu.data import SyntheticClassification, round_batches
+    from consensusml_tpu.models import MLP, mlp_loss_fn
+    from consensusml_tpu.swarm import ChurnSchedule, run_churn
+    from consensusml_tpu.topology import RingTopology
+    from consensusml_tpu.train import LocalSGDConfig
+
+    initial, rounds, seed = 4, 14, 0
+    schedule = ChurnSchedule.generate(
+        seed=seed, rounds=rounds, joins=3, drops=2, stragglers=1,
+        initial_world=initial,
+    )
+    capacity = initial + schedule.total_joins
+    model = MLP(hidden=32)
+    cfg = LocalSGDConfig(
+        gossip=GossipConfig(topology=RingTopology(initial)),
+        optimizer=optax.sgd(0.1),
+        h=2,
+    )
+    data = SyntheticClassification(n=1024, image_shape=(8, 8, 1))
+    init = lambda r: model.init(r, jnp.zeros((1, 8, 8, 1)))["params"]
+    batches = lambda n, s: round_batches(data, capacity, 2, 16, n, seed=s)
+
+    churn = run_churn(
+        cfg, mlp_loss_fn(model), init, schedule,
+        rounds=rounds, batches=batches, seed=seed,
+    )
+    # churn-free reference at CAPACITY, same stream: the equal-data
+    # baseline the loss-continuity acceptance compares against
+    import dataclasses
+
+    from consensusml_tpu.topology import rederive
+
+    flat_cfg = dataclasses.replace(
+        cfg,
+        gossip=dataclasses.replace(
+            cfg.gossip, topology=rederive(cfg.gossip.topology, capacity)
+        ),
+    )
+    flat = run_churn(
+        flat_cfg, mlp_loss_fn(model), init, ChurnSchedule(events=()),
+        rounds=rounds, batches=batches, seed=seed,
+    )
+    # steady-state round cost: median lap is robust against the per-world
+    # compile spikes; the bootstrap (the recovery/join price) is timed
+    # separately by the harness
+    steady_round_ms = 1000.0 * sorted(churn.round_s)[len(churn.round_s) // 2]
+    bootstrap_ms = [1000.0 * b.get("wall_s", 0.0) for b in churn.bootstraps]
+    return {
+        "schedule": schedule.spec(),
+        "initial_world": initial,
+        "capacity": capacity,
+        "rounds": rounds,
+        "recompiles": churn.recompiles,
+        "steady_round_ms": round(steady_round_ms, 2),
+        "bootstrap_ms_mean": round(
+            sum(bootstrap_ms) / max(len(bootstrap_ms), 1), 2
+        ),
+        "recovery_cost_rounds": round(
+            (sum(bootstrap_ms) / max(len(bootstrap_ms), 1))
+            / max(steady_round_ms, 1e-9),
+            2,
+        ),
+        "bootstraps": [
+            {
+                "round": b["round"],
+                "gossip_rounds": b["rounds"],
+                "eps_measured": b["eps_measured"],
+                "wall_ms": round(1000.0 * b.get("wall_s", 0.0), 2),
+            }
+            for b in churn.bootstraps
+        ],
+        "bootstrap_eps_worst": max(
+            (b["eps_measured"] for b in churn.bootstraps), default=None
+        ),
+        "final_loss_churn": round(churn.losses[-1], 4),
+        "final_loss_nochurn": round(flat.losses[-1], 4),
+        "loss_continuity_delta": round(
+            abs(churn.losses[-1] - flat.losses[-1]), 4
+        ),
+        "wall_s_churn": round(churn.wall_s, 2),
+        "wall_s_nochurn": round(flat.wall_s, 2),
+        "note": (
+            "bootstrap wall time is XLA-compile-dominated at this CPU "
+            "smoke scale (each new world traces the push-sum round once); "
+            "the steady cost is gossip_rounds ppermute payloads per join"
+        ),
+    }
+
+
 def _consensus_bench() -> dict:
     """The consensus-error half of the headline metric: a dozen rounds of
     8-worker ring gossip on a ResNet (the metric's advertised model
@@ -1181,6 +1287,9 @@ def main() -> None:
         return
     if "--_obs" in sys.argv:
         print("INNER_RESULT " + json.dumps(_obs_bench()), flush=True)
+        return
+    if "--_elastic" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_elastic_bench()), flush=True)
         return
     if "--_fed" in sys.argv:
         batch = int(os.environ.get("BENCH_BATCH", "128"))
@@ -1412,6 +1521,10 @@ def main() -> None:
         "observability", "--_obs", 300,
         {"XLA_FLAGS": (flags + " --xla_force_host_platform_device_count=8").strip()},
     ))
+    # elastic swarm: churn-vs-flat loss continuity, gossip-bootstrap
+    # (join) cost in rounds, worst bootstrap epsilon — simulated backend,
+    # CPU-capable (docs/elasticity.md)
+    sections.append(("elastic", "--_elastic", 420, cpu_env))
     if tpu_ok:  # host->device transfer bench is meaningless without the tunnel
         sections.append(("fed_input", "--_fed", 1500, None))
 

@@ -221,7 +221,7 @@ def _check_collective_count(name: str, bundle) -> list[Finding]:
         PASS, rule, f"configs:{name}", "gossip_round", detail, msg
     )
     if (
-        cfg.push_sum
+        cfg.push_sum_enabled
         or cfg.overlap
         or cfg.faults is not None
         or cfg.codec_warmup_rounds > 0

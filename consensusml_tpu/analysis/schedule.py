@@ -200,7 +200,7 @@ def materialize_schedules(engine, spec, *, phase=None) -> list[list[RankOp]]:
     leaf. Warmup/refresh rounds (``lax.cond`` over two wire layouts) are
     transients; this is the steady-state schedule.
     """
-    if engine.config.push_sum:
+    if engine.config.push_sum_enabled:
         raise NotImplementedError(
             "push-sum rounds add mass/flag exchanges this materializer "
             "does not model; verify push-sum wires separately"

@@ -26,6 +26,7 @@ __all__ = [
     "mix_stacked",
     "mix_tree_stacked",
     "consensus_error_stacked",
+    "consensus_error_masked",
 ]
 
 
@@ -65,4 +66,27 @@ def consensus_error_stacked(tree: Any, world_size: int) -> jax.Array:
         x = jnp.asarray(x, jnp.float32).reshape(world_size, -1)
         dev = x - jnp.mean(x, axis=0, keepdims=True)
         total = total + jnp.sum(dev**2) / world_size
+    return jnp.sqrt(total)
+
+
+def consensus_error_masked(tree: Any, alive: jax.Array) -> jax.Array:
+    """:func:`consensus_error_stacked` over the ALIVE members only.
+
+    Under churn the dead/dormant rows hold frozen (or freshly
+    bootstrapped) replicas whose deviation says nothing about the live
+    swarm's agreement; the membership harness reports this masked
+    variant alongside the all-rows metric. ``alive``: ``(world,)`` of
+    0/1 floats; both mean and deviation are restricted to the alive
+    subset (``max(sum(alive), 1)`` guards the everyone-dead round).
+    """
+    from consensusml_tpu.utils.tree import masked_worker_mean
+
+    a = jnp.asarray(alive, jnp.float32)
+    n_alive = jnp.maximum(jnp.sum(a), 1.0)
+    total = jnp.zeros((), jnp.float32)
+    for x in jax.tree.leaves(tree):
+        x = jnp.asarray(x, jnp.float32).reshape(a.shape[0], -1)
+        mean = masked_worker_mean(x, a, n_alive=n_alive)
+        dev = (x - mean[None, :]) * a[:, None]
+        total = total + jnp.sum(dev**2) / n_alive
     return jnp.sqrt(total)

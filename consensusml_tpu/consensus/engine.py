@@ -88,7 +88,18 @@ class GossipConfig:
     # ``path -> bool`` (True = compress that leaf).
     compress_filter: Any = "auto"
     faults: FaultConfig | None = None  # None => no fault model
-    push_sum: bool = False  # ratio consensus (see consensus.pushsum)
+    # Ratio consensus (see consensus.pushsum). Three values:
+    #   False  — plain gossip; faults fold at the receiver, which is
+    #            mean-preserving only on symmetric topologies (rejected
+    #            otherwise below);
+    #   True   — always push-sum;
+    #   "auto" — push-sum engages exactly when the mixing matrix can go
+    #            asymmetric under membership change (faults configured on
+    #            a directed topology); symmetric graphs keep the cheaper
+    #            receive-side fold, which coincides with push-sum there.
+    #            This is the swarm subsystem's default: recovery weights
+    #            stay a convex combination under ANY alive mask.
+    push_sum: bool | str = False
     # Fused codec: run the compressor ONCE over the CONCATENATED gossiped
     # tree instead of once per leaf. Chunking then spans leaf boundaries,
     # which changes WHICH elements a chunked top-k picks (same k per 512
@@ -161,7 +172,21 @@ class GossipConfig:
     # automatically. None => always per-leaf (the pre-bucketing wire).
     bucket_bytes: int | None = 4 * 2**20
 
+    @property
+    def push_sum_enabled(self) -> bool:
+        """The resolved push-sum switch: ``"auto"`` engages ratio
+        consensus exactly when faults are configured on an asymmetric
+        (directed) topology — the one regime where receive-side masked
+        mixing would bias the network mean."""
+        if self.push_sum == "auto":
+            return self.faults is not None and not self.topology.symmetric
+        return bool(self.push_sum)
+
     def __post_init__(self):
+        if self.push_sum not in (True, False, "auto"):
+            raise ValueError(
+                f"push_sum must be True, False or 'auto', got {self.push_sum!r}"
+            )
         if self.bucket_bytes is not None and self.bucket_bytes <= 0:
             raise ValueError(
                 f"bucket_bytes must be positive (or None for the per-leaf "
@@ -187,7 +212,7 @@ class GossipConfig:
                 "codec_refresh_every without a compressor is meaningless: "
                 "exact mixing is already dense every round"
             )
-        if self.gossip_steps > 1 and self.push_sum:
+        if self.gossip_steps > 1 and self.push_sum_enabled:
             raise NotImplementedError(
                 "gossip_steps > 1 with push-sum is not supported: the mass "
                 "ratio's bias correction is defined per round, not per "
@@ -243,7 +268,7 @@ class GossipConfig:
                     "warm round and the delayed correction disagree about "
                     "which W application the tracking state saw"
                 )
-        if self.overlap and self.push_sum:
+        if self.overlap and self.push_sum_enabled:
             raise NotImplementedError(
                 "overlap + push-sum is not supported: the mass ratio must "
                 "be updated with the same W application as the numerator, "
@@ -262,13 +287,13 @@ class GossipConfig:
                 "innovation, which a dropped round violates; use exact "
                 "gossip with faults, or compression without faults"
             )
-        if self.compressor is not None and self.push_sum:
+        if self.compressor is not None and self.push_sum_enabled:
             raise NotImplementedError(
                 "compressed push-sum is not supported: CHOCO's innovation "
                 "tracking assumes the row-stochastic mixing update, not "
                 "the biased-mass/ratio update"
             )
-        if self.faults is not None and not self.topology.symmetric and not self.push_sum:
+        if self.faults is not None and not self.topology.symmetric and not self.push_sum_enabled:
             raise NotImplementedError(
                 "fault masking requires a SYMMETRIC topology: folding a "
                 "dead peer's weight onto self keeps W doubly stochastic "
@@ -356,7 +381,7 @@ class ConsensusEngine:
         ``GossipConfig.bucket_bytes``). Push-sum rounds and codecs that do
         not decompose per-chunk fall back to the per-leaf path."""
         cfg = self.config
-        if cfg.bucket_bytes is None or cfg.fused_codec or cfg.push_sum:
+        if cfg.bucket_bytes is None or cfg.fused_codec or cfg.push_sum_enabled:
             return False
         comp = cfg.compressor
         return comp is None or comp.bucket_alignment() is not None
@@ -547,7 +572,7 @@ class ConsensusEngine:
         With a ``path_filter`` CHOCO state only covers the filtered
         (gossiped) leaves.
         """
-        if self.config.push_sum:
+        if self.config.push_sum_enabled:
             return pushsum_init(world_size)
         if self.config.overlap:
             sel = params
@@ -659,7 +684,7 @@ class ConsensusEngine:
         rng: jax.Array | None,
         step: jax.Array | None = None,
     ):
-        if self.config.push_sum:
+        if self.config.push_sum_enabled:
             if self.config.path_filter is not None:
                 sel, rebuild = self._select(params)
                 mixed, new_state = pushsum_round_collective(sel, state, topo, alive)
@@ -1048,7 +1073,7 @@ class ConsensusEngine:
                 "counter (step=...)"
             )
         n_iter = self.config.gossip_steps
-        if self.config.push_sum:
+        if self.config.push_sum_enabled:
             if self.config.path_filter is not None:
                 sel, rebuild = self._select(params)
                 mixed, new_state = pushsum_round_simulated(sel, state, w, alive)
@@ -1229,7 +1254,7 @@ class ConsensusEngine:
                 + exact_payload
             )
         sends = self._sends_per_round()
-        mass = 4 * sends if self.config.push_sum else 0
+        mass = 4 * sends if self.config.push_sum_enabled else 0
         # every extra consensus iteration ships a fresh payload
         return int(payload * sends * self.config.gossip_steps + mass)
 

@@ -45,7 +45,11 @@ __all__ = [
 ]
 
 
-def record_fault_metrics(alive_frac: float) -> None:
+def record_fault_metrics(
+    alive_frac: float,
+    alive=None,
+    prev_alive=None,
+) -> None:
     """Feed one round's alive fraction into the telemetry registry
     (host-side — the draws themselves happen inside jit, so the training
     loop reports the fetched ``alive_frac`` metric here).
@@ -53,6 +57,14 @@ def record_fault_metrics(alive_frac: float) -> None:
     Counts ``consensusml_fault_rounds_total`` (rounds where any worker
     missed the gossip) and ``consensusml_worker_drops_total`` (fractional
     worker-rounds lost), and gauges the latest alive fraction.
+
+    ``alive`` (optional): this round's per-rank 0/1 participation vector
+    (any sequence). When given, per-rank LABELED families are fed too:
+    ``consensusml_worker_drop_rounds_total{worker="i"}`` counts each
+    rank's missed gossip rounds, and — with ``prev_alive``, the previous
+    round's vector — ``consensusml_worker_recoveries_total{worker="i"}``
+    counts its 0→1 transitions (a rejoin/recovery). Label cardinality is
+    the world size, which the registry's family grouping handles.
     """
     from consensusml_tpu.obs import get_registry
 
@@ -71,6 +83,23 @@ def record_fault_metrics(alive_frac: float) -> None:
             "consensusml_worker_drops_total",
             "cumulative fraction of worker-rounds lost to faults",
         ).inc(1.0 - af)
+    if alive is None:
+        return
+    cur = [float(a) for a in alive]
+    prev = None if prev_alive is None else [float(a) for a in prev_alive]
+    for i, a in enumerate(cur):
+        if a <= 0.0:
+            reg.counter(
+                "consensusml_worker_drop_rounds_total",
+                "gossip rounds this rank missed (dropped or straggling)",
+                labels={"worker": str(i)},
+            ).inc()
+        elif prev is not None and i < len(prev) and prev[i] <= 0.0:
+            reg.counter(
+                "consensusml_worker_recoveries_total",
+                "this rank's dead→alive transitions (rejoins/recoveries)",
+                labels={"worker": str(i)},
+            ).inc()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -56,7 +56,16 @@ __all__ = [
     "pushsum_round_collective",
     "pushsum_round_simulated",
     "pushsum_matrix",
+    "MASS_FLOOR",
 ]
+
+# De-bias guard: a worker whose mass is (still) zero — a gossip-bootstrap
+# joiner before its first in-edge delivers, or a dead worker under a full
+# neighborhood outage — has a numerator that is exactly zero too (both are
+# the same non-negative convex combination), so flooring the denominator
+# turns the undefined 0/0 into the correct "no information yet" value 0
+# instead of a NaN that would re-bias into the swarm next round.
+MASS_FLOOR = 1e-12
 
 
 class PushSumState(NamedTuple):
@@ -74,6 +83,11 @@ def pushsum_init(world_size: int | None = None) -> PushSumState:
 
 def _reverse(shift: Shift) -> Shift:
     return Shift(shift.axis, -shift.offset, shift.weight)
+
+
+def _debias(m: jax.Array, w: jax.Array) -> jax.Array:
+    """``m / w`` with the :data:`MASS_FLOOR` guard (see its comment)."""
+    return m / jnp.maximum(w, MASS_FLOOR)
 
 
 def _mass_mix(x: jax.Array, topology: Topology, alive, a_src, keep):
@@ -126,7 +140,8 @@ def pushsum_round_collective(
         )
         w_new = mass(w)
         z_new = jax.tree.map(
-            lambda m, z: (m / w_new).astype(jnp.asarray(z).dtype), mixed, tree
+            lambda m, z: _debias(m, w_new).astype(jnp.asarray(z).dtype),
+            mixed, tree,
         )
         return z_new, PushSumState(w=w_new)
 
@@ -153,7 +168,8 @@ def pushsum_round_collective(
     )
     w_new = _mass_mix(w, topology, alive, a_src, keep)
     z_new = jax.tree.map(
-        lambda m, z: (m / w_new).astype(jnp.asarray(z).dtype), mixed, tree
+        lambda m, z: _debias(m, w_new).astype(jnp.asarray(z).dtype),
+        mixed, tree,
     )
     return z_new, PushSumState(w=w_new)
 
@@ -199,8 +215,8 @@ def pushsum_round_simulated(
     )
     w_new = c @ w
     z_new = jax.tree.map(
-        lambda m, z: (
-            m / w_new.reshape((n,) + (1,) * (m.ndim - 1))
+        lambda m, z: _debias(
+            m, w_new.reshape((n,) + (1,) * (m.ndim - 1))
         ).astype(jnp.asarray(z).dtype),
         mixed,
         tree,

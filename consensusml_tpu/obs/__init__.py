@@ -49,6 +49,7 @@ from consensusml_tpu.obs.metrics import (  # noqa: F401
     Counter,
     DEFAULT_LATENCY_BUCKETS,
     DEFAULT_LINK_LATENCY_BUCKETS,
+    DEFAULT_ROUND_COUNT_BUCKETS,
     Gauge,
     Histogram,
     MetricsRegistry,

@@ -34,6 +34,7 @@ import json
 import math
 import os
 import time
+from collections import deque
 from typing import Any
 
 from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry, parse_metric_key
@@ -69,6 +70,16 @@ class ClusterWriter:
         self.path = os.path.join(
             out_dir, f"{SNAP_PREFIX}{role}-{self.rank:05d}.json"
         )
+        # membership-event timeline (swarm churn): bounded ring, rewritten
+        # whole into every snapshot — latest-wins like the rest of the file
+        self._events: deque = deque(maxlen=256)
+
+    def record_event(self, event: dict[str, Any]) -> None:
+        """Append a membership/churn event row (``{"round": .., "kind":
+        "join|drop|rejoin|straggle", "workers": [..], ...}``) to the
+        timeline this writer's snapshots carry; the aggregator merges
+        every rank's rows into the cluster report's membership timeline."""
+        self._events.append(dict(event))
 
     def write(
         self, round: int | None = None, extra: dict[str, Any] | None = None
@@ -84,6 +95,8 @@ class ClusterWriter:
                 m.key: m.value_dict() for m in self.registry.metrics()
             },
         }
+        if self._events:
+            doc["swarm_events"] = list(self._events)
         if extra:
             doc.update(extra)
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -339,6 +352,65 @@ def aggregate(
             _metric(s, "consensusml_watchdog_timeouts_total", 0.0)
             for s in ranks
         ),
+        # swarm counters are REPLICATED, not per-rank: every rank's
+        # controller replays the same schedule (same reason the event
+        # timeline below dedups), so merge with max, not sum
+        "bootstrapped_joiners_total": max(
+            (
+                _metric(s, "consensusml_swarm_bootstrapped_joiners_total", 0.0)
+                for s in ranks
+            ),
+            default=0.0,
+        ),
+        "recovery_rounds_total": max(
+            (
+                _metric(s, "consensusml_swarm_recovery_rounds_total", 0.0)
+                for s in ranks
+            ),
+            default=0.0,
+        ),
+    }
+
+    # ---- membership (swarm) ---------------------------------------------
+    # per-kind event counters (labeled family) + the merged event timeline
+    # the ClusterWriter snapshots carry — what obs_report renders as the
+    # join/drop/straggler-vs-round view
+    event_counts: dict[str, float] = {}
+    timeline: list[dict[str, Any]] = []
+    seen_events = set()
+    swarm_epoch = None
+    swarm_members = None
+    for s in ranks:
+        for key, vd in s.get("metrics", {}).items():
+            name, labels = parse_metric_key(key)
+            if name == "consensusml_swarm_events_total" and "kind" in labels:
+                f = _finite(vd)
+                if f is not None:
+                    # replicated across ranks (same schedule) — max, like
+                    # the timeline dedup below, not a rank-count inflation
+                    k = labels["kind"]
+                    event_counts[k] = max(event_counts.get(k, 0.0), f)
+        e = _finite(_metric(s, "consensusml_swarm_epoch"))
+        if e is not None:
+            swarm_epoch = max(swarm_epoch or 0, e)
+        m = _finite(_metric(s, "consensusml_swarm_members"))
+        if m is not None:
+            swarm_members = m if swarm_members is None else max(swarm_members, m)
+        for row in s.get("swarm_events", []):
+            key = (
+                row.get("round"), row.get("kind"),
+                tuple(row.get("workers") or ()),
+            )
+            if key in seen_events:  # every rank replays the same schedule
+                continue
+            seen_events.add(key)
+            timeline.append(dict(row, rank=s.get("rank")))
+    timeline.sort(key=lambda r: (r.get("round") or 0, r.get("kind") or ""))
+    membership = {
+        "epoch": swarm_epoch,
+        "active_members": swarm_members,
+        "event_counts": event_counts,
+        "timeline": timeline,
     }
 
     # ---- cluster-level health -------------------------------------------
@@ -407,6 +479,7 @@ def aggregate(
         "health": health,
         "stragglers": stragglers,
         "churn": churn,
+        "membership": membership,
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

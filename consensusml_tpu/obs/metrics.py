@@ -40,6 +40,7 @@ __all__ = [
     "parse_metric_key",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_LINK_LATENCY_BUCKETS",
+    "DEFAULT_ROUND_COUNT_BUCKETS",
 ]
 
 # round latencies span ~1 ms (smoke MLP on CPU) to minutes (first-round
@@ -54,6 +55,12 @@ DEFAULT_LATENCY_BUCKETS = (
 DEFAULT_LINK_LATENCY_BUCKETS = (
     1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
     1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+# small-integer round counts (gossip-bootstrap length, recovery windows):
+# the spectral-gap-derived K lands between a handful and a few dozen
+DEFAULT_ROUND_COUNT_BUCKETS = (
+    1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
 )
 
 _VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")

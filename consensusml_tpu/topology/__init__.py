@@ -22,5 +22,6 @@ from consensusml_tpu.topology.topologies import (  # noqa: F401
     TimeVaryingTopology,
     Topology,
     TorusTopology,
+    rederive,
     topology_from_name,
 )

@@ -38,6 +38,7 @@ __all__ = [
     "OnePeerExponentialTopology",
     "HierarchicalTopology",
     "topology_from_name",
+    "rederive",
 ]
 
 
@@ -478,6 +479,47 @@ class HierarchicalTopology(TimeVaryingTopology):
         outer_phase = ring_phase(0, slices, "outer")
         phases = [inner_phase] * (outer_every - 1) + [outer_phase]
         super().__init__(phases, name="hierarchical")
+
+
+def rederive(topo: Topology, world_size: int) -> Topology:
+    """Rebuild ``topo``'s FAMILY at a new world size — the membership
+    controller's topology refresh on join/leave (consensusml_tpu.swarm).
+
+    Same family, new size: a ring stays a ring, a torus re-factors into
+    the squarest grid at the new size, a hierarchical schedule keeps its
+    slice count and period. Raises for sizes the family cannot host
+    (e.g. a slice count that no longer divides the world) — the caller
+    decides whether to fall back to another family or refuse the event.
+    """
+    if world_size == topo.world_size:
+        return topo
+    if world_size < 1:
+        raise ValueError(f"world_size must be positive, got {world_size}")
+    if isinstance(topo, HierarchicalTopology):
+        slices = topo.phases[-1].mesh_shape[0]
+        if world_size % slices:
+            raise ValueError(
+                f"hierarchical topology with slices={slices} cannot host "
+                f"world_size={world_size} (not divisible)"
+            )
+        # period = (outer_every - 1) inner phases + 1 outer phase
+        return HierarchicalTopology(
+            slices, world_size // slices, outer_every=topo.period
+        )
+    simple = {
+        "ring": "ring",
+        "dense": "dense",
+        "exp": "exp",
+        "onepeer-exp": "onepeer-exp",
+        "torus": "torus",
+    }
+    family = simple.get(topo.name)
+    if family is None:
+        raise ValueError(
+            f"cannot rederive topology {topo.name!r} at a new world size; "
+            "known families: ring|torus|dense|exp|onepeer-exp|hierarchical"
+        )
+    return topology_from_name(family, world_size)
 
 
 def topology_from_name(name: str, world_size: int, **kwargs) -> Topology:

@@ -415,6 +415,11 @@ def make_collective_train_step(
         }
         if faults is not None:
             metrics["alive_frac"] = jax.lax.pmean(alive, topo.axis_names)
+            # the per-rank mask (rank-ordered), for the labeled per-worker
+            # drop/recovery counters (consensus.faults.record_fault_metrics)
+            metrics["alive_mask"] = jnp.reshape(
+                jax.lax.all_gather(alive, topo.axis_names), (world,)
+            )
         return _unsqueeze(new_state, n_axes), metrics
 
     # donate the old TrainState so XLA updates params/opt buffers in place —
@@ -520,14 +525,25 @@ def make_collective_train_step(
 
 
 def make_simulated_train_step(
-    cfg: LocalSGDConfig, loss_fn: LossFn
-) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    cfg: LocalSGDConfig, loss_fn: LossFn, external_alive: bool = False
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted train step for stacked workers on ONE device.
 
     State/batch leaves carry a flat leading worker axis (N, ...). The inner
     loop vmaps over workers; gossip is an einsum with the mixing matrix.
     Reference parity: the CPU-simulated-workers mode (BASELINE.json
     configs[0]).
+
+    ``external_alive=True`` (the swarm churn harness): the returned step's
+    signature becomes ``step(state, batch, alive, frozen)`` with two
+    ``(world,)`` 0/1 float masks replacing the rng fault draw —
+    ``alive[i]=0`` means worker ``i`` misses this gossip round (straggler
+    or dropped), ``frozen[i]=1`` additionally rolls its inner loop back
+    entirely (a PREEMPTED member: its replica must stay untouched until
+    it rejoins, where ``drop_prob`` faults model a mere comm blip whose
+    local steps survive). Requires ``cfg.gossip.faults`` for the masked
+    gossip plumbing; use ``FaultConfig(drop_prob=0.0)`` for a purely
+    scheduled fault model.
     """
     engine = cfg.engine()
     topo = cfg.gossip.topology
@@ -540,9 +556,14 @@ def make_simulated_train_step(
     faults = cfg.gossip.faults
     comp = cfg.gossip.compressor
     stochastic_comp = comp is not None and comp.stochastic
+    if external_alive and faults is None:
+        raise ValueError(
+            "external_alive needs cfg.gossip.faults (the alive-mask gossip "
+            "plumbing); use FaultConfig(drop_prob=0.0) for scheduled-only "
+            "churn"
+        )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, batch: Any):
+    def _round(state: TrainState, batch: Any, alive_in, frozen):
         def worker(params, model_state, opt_state, rng, batch):
             return _inner_loop(cfg, loss_fn, params, model_state, opt_state, rng, batch)
 
@@ -581,26 +602,37 @@ def make_simulated_train_step(
             alive = None
             mean_loss = jnp.mean(losses)
         else:
-            # identical per-worker draws/checks as the collective backend
-            rng, fsub = (
-                lambda s: (s[:, 0], s[:, 1])
-            )(jax.vmap(jax.random.split)(rng))
-            inject = jax.vmap(draw_alive, in_axes=(0, None))(fsub, faults.drop_prob)
+            if alive_in is None:
+                # identical per-worker draws/checks as the collective backend
+                rng, fsub = (
+                    lambda s: (s[:, 0], s[:, 1])
+                )(jax.vmap(jax.random.split)(rng))
+                inject = jax.vmap(draw_alive, in_axes=(0, None))(
+                    fsub, faults.drop_prob
+                )
+            else:
+                inject = alive_in  # scheduled churn: deterministic masks
             ok = (
                 # model_state gossips too, so it must pass the finite check
                 jax.vmap(tree_all_finite)(losses, (params, model_state))
                 if faults.detect_nonfinite
                 else jnp.ones_like(losses)
             )
+            # rows to roll back: non-finite inner loops always; frozen
+            # (preempted) members too — their replica is elsewhere, the
+            # local steps this program ran for them never happened
+            keep = ok if frozen is None else ok * (1.0 - frozen)
             bc = lambda m, x: m.reshape(m.shape + (1,) * (x.ndim - 1))
             revert = lambda new, old: jax.tree.map(
-                lambda a, b: jnp.where(bc(ok, a) > 0, a, b), new, old
+                lambda a, b: jnp.where(bc(keep, a) > 0, a, b), new, old
             )
             params = revert(params, state.params)
             model_state = revert(model_state, state.model_state)
             opt_state = revert(opt_state, state.opt_state)
-            alive = inject * ok
-            mean_loss = jnp.sum(ok * losses) / jnp.maximum(jnp.sum(ok), 1.0)
+            alive = inject * keep
+            mean_loss = jnp.sum(keep * losses) / jnp.maximum(
+                jnp.sum(keep), 1.0
+            )
         if stochastic_comp:
             rng, gsub = (
                 lambda s: (s[:, 0], s[:, 1])
@@ -633,6 +665,19 @@ def make_simulated_train_step(
         metrics = {"loss": mean_loss, "consensus_error": err}
         if faults is not None:
             metrics["alive_frac"] = jnp.mean(alive)
+            metrics["alive_mask"] = alive
         return new_state, metrics
+
+    if external_alive:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state: TrainState, batch: Any, alive, frozen):
+            return _round(state, batch, alive, frozen)
+
+    else:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state: TrainState, batch: Any):
+            return _round(state, batch, None, None)
 
     return train_step

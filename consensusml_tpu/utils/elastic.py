@@ -56,11 +56,22 @@ def _grow(tree: Any, mean_tree: Any, n_new: int) -> Any:
     )
 
 
+def _grow_rows(tree: Any, rows: Any) -> Any:
+    """Append already-stacked ``(n_new, ...)`` joiner rows to each leaf."""
+    return jax.tree.map(
+        lambda x, r: jnp.concatenate([x, jnp.asarray(r).astype(x.dtype)]),
+        tree,
+        rows,
+    )
+
+
 def resize_state(
     cfg: LocalSGDConfig,
     state: TrainState,
     new_world: int,
     rng: jax.Array | None = None,
+    joiner_params: Any | None = None,
+    joiner_model_state: Any | None = None,
 ) -> TrainState:
     """Return ``state`` resized to ``new_world`` stacked replicas.
 
@@ -68,6 +79,14 @@ def resize_state(
     streams when growing (defaults to ``jax.random.key(0)``). The result
     is host-side/unsharded — re-shard with ``WorkerMesh.shard_stacked``
     for the collective backend.
+
+    ``joiner_params`` / ``joiner_model_state`` (grow only): stacked
+    ``(n_new, ...)`` rows the joiners start from INSTEAD of the
+    consensus-mean broadcast — the swarm gossip-bootstrap path
+    (:mod:`consensusml_tpu.swarm.bootstrap`), where a joiner has already
+    reconstructed its replica from neighbor gossip and no checkpoint was
+    read. Optimizer state is fresh either way (initialized on the joiner
+    rows); everything else follows the same grow semantics.
     """
     old_world = int(state.step.shape[0])
     if new_world == old_world:
@@ -92,6 +111,8 @@ def resize_state(
     ).set(new_world)
 
     if new_world < old_world:
+        if joiner_params is not None:
+            raise ValueError("joiner_params only applies when growing")
         params = _take(state.params, new_world)
         model_state = _take(state.model_state, new_world)
         opt_state = _take(state.opt_state, new_world)
@@ -99,14 +120,24 @@ def resize_state(
         step = state.step[:new_world]
     else:
         n_new = new_world - old_world
-        mean_p = consensus_mean(state.params)
-        mean_ms = consensus_mean(state.model_state)
-        params = _grow(state.params, mean_p, n_new)
-        model_state = _grow(state.model_state, mean_ms, n_new)
-        # joiners: fresh optimizer state on their (mean) params
-        new_block = jax.tree.map(
-            lambda m: jnp.broadcast_to(m[None], (n_new, *m.shape)), mean_p
-        )
+        if joiner_params is not None:
+            # gossip-bootstrapped joiners: rows come from the caller
+            params = _grow_rows(state.params, joiner_params)
+            model_state = (
+                _grow_rows(state.model_state, joiner_model_state)
+                if joiner_model_state is not None
+                else _grow(state.model_state, consensus_mean(state.model_state), n_new)
+            )
+            new_block = jax.tree.map(jnp.asarray, joiner_params)
+        else:
+            mean_p = consensus_mean(state.params)
+            mean_ms = consensus_mean(state.model_state)
+            params = _grow(state.params, mean_p, n_new)
+            model_state = _grow(state.model_state, mean_ms, n_new)
+            # joiners: fresh optimizer state on their (mean) params
+            new_block = jax.tree.map(
+                lambda m: jnp.broadcast_to(m[None], (n_new, *m.shape)), mean_p
+            )
         new_opt = jax.vmap(cfg.optimizer.init)(new_block)
         opt_state = jax.tree.map(
             lambda a, b: jnp.concatenate([a, b.astype(a.dtype)]),

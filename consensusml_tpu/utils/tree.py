@@ -9,6 +9,13 @@ elastic joiner bootstrap (``utils/elastic.py``), and the serving export
 logits match the eval path exactly) — so it lives here once instead of
 as three inlined tree-maps that could drift.
 
+Under churn the same contract extends to the ALIVE subset:
+:func:`masked_worker_mean` is the one definition of the alive-weighted
+leaf mean that gossip bootstrap (``swarm/bootstrap.py``), dead-row
+aggregation (``swarm/harness.py``), and the masked agreement metric
+(``comm/simulated.py``) all reduce with — same f32 accumulation, same
+``max(sum(alive), 1)`` everyone-dead guard.
+
 Pure ``jnp``: safe to call inside jit (evaluate does) or eagerly on host
 trees (elastic resume, export).
 """
@@ -20,16 +27,39 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["consensus_mean"]
+__all__ = ["consensus_mean", "masked_worker_mean"]
 
 
-def consensus_mean(tree: Any) -> Any:
+def masked_worker_mean(x, alive, n_alive=None):
+    """f32 alive-weighted mean of ONE leaf over its leading stacked axis.
+
+    ``alive``: ``(world,)`` of 0/1 floats. Rows with 0 weight contribute
+    nothing; the divisor is ``max(sum(alive), 1)`` (pass ``n_alive`` to
+    reuse a precomputed divisor) so an everyone-dead round yields 0, not
+    NaN. Returns f32 at the leaf's trailing shape — callers cast back.
+    """
+    a = jnp.asarray(alive, jnp.float32)
+    x32 = jnp.asarray(x, jnp.float32)
+    w = a.reshape((a.shape[0],) + (1,) * (x32.ndim - 1))
+    n = jnp.maximum(jnp.sum(a), 1.0) if n_alive is None else n_alive
+    return jnp.sum(x32 * w, axis=0) / n
+
+
+def consensus_mean(tree: Any, alive=None) -> Any:
     """Worker-mean over the leading stacked axis of every leaf.
 
     Reduces in f32 (bf16 accumulation would lose low bits exactly where
-    replicas disagree least) and casts back to each leaf's dtype.
+    replicas disagree least) and casts back to each leaf's dtype. With
+    ``alive`` (a ``(world,)`` 0/1 vector) the mean is restricted to the
+    alive rows — the churn-regime variant (docs/elasticity.md).
     """
+    if alive is None:
+        return jax.tree.map(
+            lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(
+                x.dtype
+            ),
+            tree,
+        )
     return jax.tree.map(
-        lambda x: jnp.mean(jnp.asarray(x, jnp.float32), axis=0).astype(x.dtype),
-        tree,
+        lambda x: masked_worker_mean(x, alive).astype(x.dtype), tree
     )

@@ -22,6 +22,18 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def compat_shard_map():
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map``
+    elsewhere (this box's 0.4.37 only has the experimental path). The one
+    version shim the suite shares — a jax bump edits it here once."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm
+
+
 # ---------------------------------------------------------------------------
 # fast/slow test tiers
 #

@@ -32,10 +32,9 @@ from consensusml_tpu.consensus import (
 )
 from consensusml_tpu.topology import DenseTopology, RingTopology
 
-try:
-    _shard_map = jax.shard_map
-except AttributeError:  # jax < 0.5 keeps shard_map under experimental
-    from jax.experimental.shard_map import shard_map as _shard_map
+from tests.conftest import compat_shard_map
+
+_shard_map = compat_shard_map()
 
 WORLD = 8
 TOPO = RingTopology(WORLD)

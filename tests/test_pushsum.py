@@ -17,11 +17,14 @@ import numpy as np
 import optax
 import pytest
 
+from tests.conftest import compat_shard_map
+
 from consensusml_tpu.comm import WorkerMesh, simulated
 from consensusml_tpu.consensus import (
     ConsensusEngine,
     FaultConfig,
     GossipConfig,
+    PushSumState,
     pushsum_init,
     pushsum_matrix,
     pushsum_round_collective,
@@ -115,6 +118,127 @@ def test_receive_side_masking_biases_mean_on_directed_graph():
 
 
 # ---------------------------------------------------------------------------
+# asymmetric, TIME-VARYING alive masks: drop mid-sequence, rejoin later
+# ---------------------------------------------------------------------------
+
+
+def _mask_sequence(n, rounds):
+    """Deterministic churn-shaped mask sequence: worker 2 drops at round 3
+    and rejoins two rounds later; worker 5 drops at round 6 and rejoins at
+    round 8; everyone else stays up."""
+    masks = []
+    for t in range(rounds):
+        a = np.ones(n, np.float32)
+        if 3 <= t < 5:
+            a[2] = 0.0
+        if 6 <= t < 8 and n > 5:
+            a[5] = 0.0
+        masks.append(jnp.asarray(a))
+    return masks
+
+
+def test_pushsum_mass_conserved_under_time_varying_asymmetric_masks():
+    """Mass conservation + weight convexity, round by round, while the
+    alive mask CHANGES between rounds of a directed time-varying
+    schedule (the swarm drop→rejoin scenario)."""
+    n, rounds = 8, 10
+    topo = OnePeerExponentialTopology(n)
+    ws = [simulated.mixing_matrix(p) for p in topo.phases]
+    rng = np.random.default_rng(4)
+    x = {"p": jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)}
+    state = pushsum_init(n)
+    mass_sum0 = float(np.sum(np.asarray(state.w)))
+    num_sum0 = np.asarray(x["p"]).astype(np.float64).sum(axis=0)
+    for t, alive in enumerate(_mask_sequence(n, rounds)):
+        w_mat = ws[t % len(ws)]
+        # weight CONVEXITY of the masked operator every round: columns
+        # sum to 1 and every entry stays in [0, 1]
+        c = np.asarray(pushsum_matrix(w_mat, alive))
+        np.testing.assert_allclose(c.sum(axis=0), 1.0, atol=1e-6)
+        assert (c >= -1e-12).all() and (c <= 1.0 + 1e-12).all()
+        x, state = pushsum_round_simulated(x, state, w_mat, alive)
+        # total mass and total (re-biased) numerator are conserved under
+        # EVERY mask, including the rounds where membership just changed
+        w_now = np.asarray(state.w, np.float64)
+        np.testing.assert_allclose(w_now.sum(), mass_sum0, rtol=1e-5)
+        num_now = (
+            np.asarray(x["p"], np.float64) * w_now[:, None]
+        ).sum(axis=0)
+        np.testing.assert_allclose(num_now, num_sum0, rtol=1e-4, atol=1e-4)
+    # and the de-biased estimates still head for the TRUE initial mean
+    mean0 = num_sum0 / n
+    for _ in range(120):
+        for w_mat in ws:
+            x, state = pushsum_round_simulated(x, state, w_mat)
+    np.testing.assert_allclose(
+        np.asarray(x["p"]), np.broadcast_to(mean0, (n, 6)), atol=1e-3
+    )
+
+
+def test_pushsum_round_collective_time_varying_asymmetric_masks():
+    """pushsum_round_collective under the SAME drop-mid-sequence/
+    rejoin-two-rounds-later mask sequence: per-round mass conservation,
+    cross-backend agreement with the matrix operator, and weight
+    positivity for every alive worker."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    n, rounds = 8, 10
+    topo = OnePeerExponentialTopology(n)
+    phases = list(topo.phases)
+    ws = [simulated.mixing_matrix(p) for p in phases]
+    wmesh = WorkerMesh.create(
+        phases[0], devices=jax.devices("cpu")[:n]
+    )
+    worker = P(*phases[0].axis_names)
+    shard_map = compat_shard_map()
+
+    def one_round(phase):
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=wmesh.mesh,
+            in_specs=(worker, worker, worker),
+            out_specs=(worker, worker),
+        )
+        def f(x, w, alive):
+            sq = lambda v: v.reshape(v.shape[1:])
+            z, st = pushsum_round_collective(
+                {"p": sq(x)}, PushSumState(w=sq(w)), phase, sq(alive)
+            )
+            un = lambda v: v.reshape((1,) + v.shape)
+            return un(z["p"]), un(st.w)
+
+        return f
+
+    steps = [one_round(p) for p in phases]
+    rng = np.random.default_rng(5)
+    x0 = jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)
+    x_col, w_col = x0, jnp.ones((n,), jnp.float32)
+    x_sim, st_sim = {"p": x0}, pushsum_init(n)
+    for t, alive in enumerate(_mask_sequence(n, rounds)):
+        x_col, w_col = steps[t % len(phases)](x_col, w_col, alive)
+        x_sim, st_sim = pushsum_round_simulated(
+            x_sim, st_sim, ws[t % len(ws)], alive
+        )
+        w_host = np.asarray(w_col, np.float64)
+        # mass conserved every round of the asymmetric masked sequence
+        np.testing.assert_allclose(w_host.sum(), float(n), rtol=1e-5)
+        # weights stay a convex combination: non-negative everywhere,
+        # strictly positive for alive workers
+        assert (w_host >= -1e-6).all()
+        assert (w_host[np.asarray(alive) > 0] > 0).all()
+        # the two backends run the identical operator
+        np.testing.assert_allclose(
+            np.asarray(x_col), np.asarray(x_sim["p"]), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            w_host, np.asarray(st_sim.w), rtol=1e-5, atol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
 # collective backend agreement
 # ---------------------------------------------------------------------------
 
@@ -129,7 +253,7 @@ def _collective_round(topo, x_stacked, w_stacked, alive_stacked):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        compat_shard_map(),
         mesh=wmesh.mesh,
         in_specs=(worker, worker, worker),
         out_specs=(worker, worker),

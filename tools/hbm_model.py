@@ -231,7 +231,9 @@ def predict(
             # make the fused/bucketed CHOCO state misread the per-worker
             # tree as stacked
             world_size=(
-                cfg.gossip.topology.world_size if cfg.gossip.push_sum else None
+                cfg.gossip.topology.world_size
+                if cfg.gossip.push_sum_enabled
+                else None
             ),
         ),
         params,

@@ -5,7 +5,10 @@ Merges every rank's ``obs-*.json`` snapshot (and any loadgen/client
 snapshots and flight-recorder dumps living in the same directory) into
 one report: per-rank round/latency skew, slowest-link ranking with the
 bytes each edge carries, measured-vs-bound consensus health, straggler
-detection, and churn counters. See docs/observability.md "Cluster view".
+detection, churn counters, and the swarm membership timeline
+(join/drop/straggler events vs round, with each join's gossip-bootstrap
+cost and epsilon). See docs/observability.md "Cluster view" and
+docs/elasticity.md.
 
     python tools/obs_report.py /shared/obs            # text report
     python tools/obs_report.py /shared/obs --json     # full JSON doc
@@ -31,6 +34,10 @@ def _fmt_s(v) -> str:
     if v >= 1e-3:
         return f"{v * 1e3:.2f}ms"
     return f"{v * 1e6:.1f}us"
+
+
+def _int_or_dash(v) -> str:
+    return "-" if v is None else f"{v:.0f}"
 
 
 def _fmt_b(v) -> str:
@@ -96,8 +103,40 @@ def render_text(doc: dict) -> str:
         f"joins={c['joined_workers_total']:.0f} "
         f"fault_rounds={c['fault_rounds_total']:.0f} "
         f"drops={c['worker_drops_total']:.2f} "
-        f"watchdog_timeouts={c['watchdog_timeouts_total']:.0f}"
+        f"watchdog_timeouts={c['watchdog_timeouts_total']:.0f} "
+        f"gossip_bootstraps={c.get('bootstrapped_joiners_total', 0):.0f} "
+        f"recovery_rounds={c.get('recovery_rounds_total', 0):.0f}"
     )
+    mem = doc.get("membership") or {}
+    if mem.get("timeline") or mem.get("event_counts"):
+        counts = mem.get("event_counts") or {}
+        add(
+            f"membership: epoch={_int_or_dash(mem.get('epoch'))} "
+            f"active={_int_or_dash(mem.get('active_members'))} events=["
+            + " ".join(f"{k}:{v:.0f}" for k, v in sorted(counts.items()))
+            + "]"
+        )
+        if mem.get("timeline"):
+            add("membership timeline (round : event):")
+            glyph = {
+                "join": "+", "drop": "x", "rejoin": "^", "straggle": "~"
+            }
+            for row in mem["timeline"]:
+                ws = ",".join(f"w{u}" for u in (row.get("workers") or []))
+                detail = row.get("detail") or {}
+                extra = ""
+                if "bootstrap_rounds" in detail:
+                    extra = (
+                        f"  [bootstrap {detail['bootstrap_rounds']} rounds, "
+                        f"eps {detail['eps_measured']:.2e}]"
+                    )
+                elif "duration" in detail:
+                    extra = f"  [{detail['duration']} rounds]"
+                add(
+                    f"  {row.get('round'):>5} : "
+                    f"{glyph.get(row.get('kind'), '?')} "
+                    f"{row.get('kind'):<8} {ws}{extra}"
+                )
     if doc["flight_recorders"]:
         add("flight recorders:")
         for fr in doc["flight_recorders"]:

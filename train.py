@@ -49,10 +49,15 @@ def parse_args(argv=None):
                         "decay (e.g. 0.8); default off")
     p.add_argument("--workers", type=int, default=None,
                    help="override the config's worker count (topology is "
-                        "rebuilt at this size). With --resume this is the "
-                        "ELASTIC path: a checkpoint from any world size is "
-                        "resized — joiners start from the consensus mean, "
-                        "leavers' replicas are dropped (utils.elastic)")
+                        "rebuilt at this size). Two resume paths exist: "
+                        "with --resume this is the CHECKPOINT-BOUNDARY "
+                        "elastic path — a checkpoint from any world size "
+                        "is resized, joiners start from the consensus mean "
+                        "of the checkpointed replicas, leavers' replicas "
+                        "are dropped (utils.elastic); LIVE joins mid-run "
+                        "ride --churn-schedule instead — joiners "
+                        "gossip-bootstrap from their neighbors with no "
+                        "checkpoint read (consensusml_tpu.swarm)")
     p.add_argument("--topology", default=None,
                    help='override the config\'s gossip graph: "ring", "torus", '
                         '"dense", "exp", "onepeer-exp", or with args e.g. '
@@ -95,6 +100,19 @@ def parse_args(argv=None):
     p.add_argument("--push-sum", action="store_true",
                    help="ratio-consensus averaging (exact mean on directed "
                         "topologies and under faults; see consensus.pushsum)")
+    p.add_argument("--churn-schedule", default=None, metavar="SPEC",
+                   help="train under LIVE membership churn on the simulated "
+                        "backend (consensusml_tpu.swarm): SPEC is either a "
+                        'seeded generator ("seed=0,rounds=12,joins=3,'
+                        'drops=2,stragglers=1") or explicit events '
+                        '("join@5:1;drop@4:2;rejoin@6:2;straggle@7:3x2"). '
+                        "Drops freeze the member's replica until rejoin and "
+                        "mask it out of gossip mid-round (push-sum-weighted "
+                        "recovery engages automatically when the mixing "
+                        "matrix goes asymmetric); joiners gossip-bootstrap "
+                        "their replica from neighbors — no checkpoint read "
+                        "— and participate from the next round. See "
+                        "docs/elasticity.md")
     p.add_argument("--native-loader", action="store_true",
                    help="assemble round batches with the C++ prefetch ring "
                         "(producer threads run ahead of the device; see "
@@ -542,6 +560,38 @@ def main(argv=None) -> int:
             print(f"error: --slowmo-beta: {e}", file=sys.stderr)
             return 2
 
+    if args.churn_schedule is not None:
+        # the live-membership path: a dedicated loop (swarm.run_churn)
+        # replaces the fixed-world round loop below
+        bad = [
+            flag
+            for flag, on in [
+                ("--backend collective", args.backend == "collective"),
+                ("--model-axes", args.model_axes is not None),
+                ("--native-loader", args.native_loader),
+                ("--resume", args.resume is not None),
+                ("--drop-prob", args.drop_prob > 0),
+                ("--overlap-gossip", args.overlap_gossip),
+                ("--checkpoint-every", args.checkpoint_every > 0),
+                ("--eval-every", args.eval_every > 0),
+                ("--profile-dir", args.profile_dir is not None),
+                ("--link-probes", args.link_probes),
+                ("--flight-recorder", args.flight_recorder is not None),
+                ("--round-timeout", args.round_timeout > 0),
+            ]
+            if on
+        ]
+        if bad:
+            print(
+                f"error: --churn-schedule runs the simulated swarm loop "
+                f"and does not compose with {', '.join(bad)} "
+                "(scheduled churn IS the fault model; end-of-run "
+                "--checkpoint-dir / --eval-batches still work)",
+                file=sys.stderr,
+            )
+            return 2
+        return _churn_loop(args, bundle, scale)
+
     model_axes = bundle.model_axes
     user_set_axes = args.model_axes is not None
     if user_set_axes:
@@ -844,6 +894,186 @@ def main(argv=None) -> int:
         )
 
 
+def _churn_loop(args, bundle, scale) -> int:
+    """The --churn-schedule path: live membership churn on the simulated
+    backend (consensusml_tpu.swarm; docs/elasticity.md). Joiners
+    gossip-bootstrap from neighbors — no checkpoint read — drops freeze
+    the member's replica until rejoin, and training never stops."""
+    import jax
+
+    from consensusml_tpu import configs
+    from consensusml_tpu.obs import ClusterWriter, get_registry, get_tracer
+    from consensusml_tpu.swarm import (
+        ChurnSchedule,
+        churn_config,
+        run_churn,
+        validate_schedule,
+    )
+    from consensusml_tpu.utils import MetricsLogger
+
+    registry = get_registry()
+    initial = bundle.world_size
+    try:
+        schedule = ChurnSchedule.parse(
+            args.churn_schedule, initial_world=initial
+        )
+        cfg = churn_config(bundle.cfg)
+        # dry-replay the whole schedule up front: a semantically invalid
+        # sequence (e.g. rejoin of a never-dropped member) must be a
+        # clean rc=2 here, not a traceback after training started
+        validate_schedule(schedule, cfg.gossip.topology, args.rounds)
+    except (ValueError, NotImplementedError) as e:
+        print(f"error: --churn-schedule: {e}", file=sys.stderr)
+        return 2
+    capacity = initial + schedule.total_joins
+    counts = schedule.counts()
+    print(
+        f"churn schedule: {schedule.spec()}",
+        flush=True,
+    )
+    print(
+        f"swarm: initial={initial} capacity={capacity} "
+        f"joins={counts['join']} drops={counts['drop']} "
+        f"rejoins={counts['rejoin']} stragglers={counts['straggle']} "
+        f"push_sum={cfg.gossip.push_sum!r}",
+        flush=True,
+    )
+    # batches come stacked at CAPACITY; the harness slices to the live
+    # world each round, so slot i's stream is churn-independent
+    cap_bundle = (
+        bundle
+        if capacity == initial
+        else configs.build(
+            bundle.name, scale, data_dir=args.data_dir, world=capacity
+        )
+    )
+
+    if args.trace_events or args.metrics_prom or args.obs_cluster_dir:
+        get_tracer().enabled = True
+    cluster = None
+    if args.obs_cluster_dir:
+        cluster = ClusterWriter(
+            args.obs_cluster_dir,
+            rank=jax.process_index(),
+            registry=registry,
+            world_size=capacity,
+        )
+        print(f"cluster snapshots: {cluster.path}", flush=True)
+
+    # the logger handles JSONL + per-round registry gauges; its console
+    # print goes to devnull so the churn-format line below (epoch/active
+    # as ints) is the ONE round line, not a near-duplicate pair
+    with open(os.devnull, "w") as devnull, MetricsLogger(
+        args.metrics_out, every=args.log_every, stream=devnull
+    ) as logger:
+
+        def on_round(rnd, row):
+            logger.log(rnd, row)
+            registry.counter(
+                "consensusml_rounds_total", "completed training rounds"
+            ).inc()
+            registry.gauge("consensusml_round_progress").set(rnd)
+            registry.gauge("consensusml_heartbeat_time_seconds").set(
+                time.time()
+            )
+            if rnd % max(1, args.log_every) == 0:
+                print(
+                    f"[round {rnd}] loss={row['loss']:.4f} "
+                    f"consensus_error={row['consensus_error']:.4f} "
+                    f"epoch={row['epoch']} active={row['active']}/"
+                    f"{row['world']}",
+                    flush=True,
+                )
+            if (rnd + 1) % max(1, args.telemetry_every) == 0:
+                registry.snapshot({"round": rnd})
+                if args.metrics_prom:
+                    registry.write_prometheus(args.metrics_prom)
+                if cluster is not None:
+                    cluster.write(round=rnd)
+
+        def on_event(row):
+            workers = ",".join(str(u) for u in row["workers"])
+            detail = row.get("detail") or {}
+            extra = (
+                f" (bootstrap {detail['bootstrap_rounds']} rounds, "
+                f"eps {detail['eps_measured']:.2e})"
+                if "bootstrap_rounds" in detail
+                else (
+                    f" ({detail['duration']} rounds)"
+                    if "duration" in detail
+                    else ""
+                )
+            )
+            print(
+                f"[round {row['round']}] membership {row['kind']}: "
+                f"w{workers}{extra}",
+                flush=True,
+            )
+            if cluster is not None:
+                cluster.record_event(row)
+
+        report = run_churn(
+            cfg,
+            bundle.loss_fn,
+            bundle.init_params,
+            schedule,
+            rounds=args.rounds,
+            batches=lambda rounds, seed: cap_bundle.batches(rounds, seed),
+            seed=args.seed,
+            registry=registry,
+            on_round=on_round,
+            on_event=on_event,
+        )
+        if args.metrics_prom:
+            registry.write_prometheus(args.metrics_prom)
+        if cluster is not None:
+            cluster.write(round=args.rounds - 1)
+    if args.trace_events:
+        print(
+            f"trace events: {get_tracer().write_chrome_trace(args.trace_events)}",
+            flush=True,
+        )
+
+    view = report.final_view
+    print(
+        f"swarm final: epoch={view.epoch} members={view.n_active} active / "
+        f"{view.world_size} slots, {len(report.bootstraps)} gossip "
+        f"bootstraps (no checkpoint reads), {report.recompiles} step "
+        f"rebuilds",
+        flush=True,
+    )
+    print(
+        f"final: loss={report.losses[-1]:.4f} "
+        f"consensus_error={report.consensus_errors[-1]:.4f}",
+        flush=True,
+    )
+    if args.checkpoint_dir:
+        from consensusml_tpu.utils import save_state
+
+        path = save_state(
+            os.path.join(args.checkpoint_dir, f"step_{args.rounds}"),
+            report.final_state,
+        )
+        print(f"checkpoint: {path}", flush=True)
+    if args.eval_batches > 0:
+        from consensusml_tpu.swarm import alive_consensus_state
+        from consensusml_tpu.train import evaluate
+
+        # members still DOWN at end of run hold frozen stale replicas;
+        # the mean model must aggregate the LIVE swarm only
+        result = evaluate(
+            cap_bundle.eval_fn,
+            alive_consensus_state(report.final_state, view),
+            cap_bundle.eval_batches(args.eval_batches, args.seed),
+        )
+        fmt = lambda d: " ".join(
+            f"{k}={float(v):.4f}" for k, v in sorted(d.items())
+        )
+        print(f"eval[mean-model]: {fmt(result['mean_model'])}", flush=True)
+        print(f"eval[worker-avg]: {fmt(result['worker_mean'])}", flush=True)
+    return 0
+
+
 def _train_loop(
     args, bundle, engine, wire, step, state, start, backend, wmesh,
     logger, tracer, registry, recorder, telemetry_on, scale,
@@ -1047,6 +1277,7 @@ def _train_loop(
         place=not multiproc,
     )
     batch_shardings = None
+    prev_alive_mask = None
     try:
         for i, batch in enumerate(feed):
             rnd = start + i
@@ -1066,6 +1297,9 @@ def _train_loop(
                 profiling.__exit__(None, None, None)
                 profiling = contextlib.nullcontext()
                 print(f"profile trace: {args.profile_dir}", flush=True)
+            # the (world,) participation vector feeds the per-rank fault
+            # counters below, not the scalar log line
+            alive_mask = metrics.pop("alive_mask", None)
             logger.log(rnd, metrics)  # float() fetches => a real execution fence
             # per-round registry feed: a few float stores — cheap enough to
             # stay on unconditionally (docs/observability.md schema)
@@ -1095,7 +1329,17 @@ def _train_loop(
             if "alive_frac" in metrics:
                 from consensusml_tpu.consensus import record_fault_metrics
 
-                record_fault_metrics(float(metrics["alive_frac"]))
+                # the mask feeds the per-rank labeled drop/recovery
+                # counters (one small fetch; only on fault-model runs)
+                mask = (
+                    None if alive_mask is None else jax.device_get(alive_mask)
+                )
+                record_fault_metrics(
+                    float(metrics["alive_frac"]),
+                    alive=mask,
+                    prev_alive=prev_alive_mask,
+                )
+                prev_alive_mask = mask
             if telemetry_on and (rnd + 1) % max(1, args.telemetry_every) == 0:
                 telemetry_tick(rnd, state)
             if watchdog is not None:
