@@ -334,47 +334,163 @@ def _check_decode_jaxpr(name: str, bundle) -> list[Finding]:
     # to the byte-identical program — zero recompiles across decode
     # steps at ANY slot occupancy / length mix (fill level is data)
     out_tokens, out_cache = jax.eval_shape(decode, params, cache, tokens, positions)
+    findings += _hash_stable(
+        mk, decode, closed,
+        (params, out_cache, out_tokens, positions),
+        "decode", "signature-hash",
+    )
+    findings += _cache_drift(
+        mk, cache, out_cache, "the KV cache", "cache-drift",
+        "donation and the jit cache both break",
+    )
+    return findings
+
+
+def _hash_stable(mk, fn, closed, out_args, what: str, detail: str) -> list[Finding]:
+    """Step-over-step recompile contract for one jitted serving stage:
+    feeding step r's OUTPUT arrays back as step r+1's input must retrace
+    to the byte-identical canonical jaxpr (one compile serves steady
+    state). ``closed`` is step r's ALREADY-traced jaxpr — every caller
+    holds it from the callback/f64 pass, so only step r+1 traces here."""
+    import jax
+
     h1 = _canonical_hash(closed)
-    h2 = _canonical_hash(jax.make_jaxpr(decode)(params, out_cache, out_tokens, positions))
+    h2 = _canonical_hash(jax.make_jaxpr(fn)(*out_args))
     if h1 != h2:
-        findings.append(
+        return [
             mk(
-                "recompile", "signature-hash",
-                "decode step r+1 (fed step r's output cache) traces to a "
+                "recompile", detail,
+                f"{what} step r+1 (fed step r's outputs) traces to a "
                 "DIFFERENT program than step r — the engine recompiles "
-                "mid-request; diff the two jaxprs for the drifting "
+                "in steady state; diff the two jaxprs for the drifting "
                 "dtype/shape/weak-type",
             )
-        )
-    in_flat = jax.tree.leaves(cache)
-    out_flat = jax.tree.leaves(out_cache)
+        ]
+    return []
+
+
+def _cache_drift(
+    mk, cache_in, cache_out, what: str, detail: str, tail: str
+) -> list[Finding]:
+    """Structure/shape/dtype stability of a serving cache pytree across
+    one step (the other half of the recompile contract: donation and the
+    jit cache both key on it)."""
+    import jax
+
+    in_flat = jax.tree.leaves(cache_in)
+    out_flat = jax.tree.leaves(cache_out)
     drift = [
-        (a.shape, a.dtype, b.shape, b.dtype)
+        1
         for a, b in zip(in_flat, out_flat)
         if a.shape != b.shape or a.dtype != b.dtype
     ]
     if len(in_flat) != len(out_flat) or drift:
-        findings.append(
+        return [
             mk(
-                "recompile", "cache-drift",
-                f"KV cache changes structure across a decode step "
+                "recompile", detail,
+                f"{what} changes structure across a step "
                 f"({len(in_flat)} -> {len(out_flat)} leaves, "
-                f"{len(drift)} leaf shape/dtype changes): donation and "
-                "the jit cache both break",
+                f"{len(drift)} leaf shape/dtype changes): {tail}",
             )
+        ]
+    return []
+
+
+def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
+    """Paged serving-stage contracts (causal-LM configs only).
+
+    The pool engine (``serve/pool/``) runs TWO separately-jitted stages;
+    each carries the full contract set INDEPENDENTLY — a clean decode
+    jaxpr does not excuse a host callback in the prefill scatter:
+
+    - no host callbacks anywhere, in particular not in the block-index
+      computation (``physical = table[s, p // bs]`` must stay on device
+      — a host round-trip there fences the pipeline once per token);
+    - no f64/complex128 (block indices are int32; KV pages are the
+      model's compute dtype);
+    - step-over-step canonical-jaxpr hash stable PER STAGE: prefill's
+      output pages feed the next prefill, decode's output pages feed the
+      next decode — both must retrace byte-identically, and the page
+      pytree must be structure/shape/dtype-stable (donation depends on
+      it).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve import decode as D
+    from consensusml_tpu.serve import pool as P
+
+    if bundle.model is None or not D.supports_decode(bundle.model):
+        return []
+    findings: list[Finding] = []
+    dm = D.DecodeModel.wrap(bundle.model)
+    slots, max_len, bs = 4, min(dm.max_len, 32), 8
+    blocks_per_slot = max_len // bs
+    num_blocks = slots * blocks_per_slot + 1
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    params = probe[0] if isinstance(probe, tuple) and len(probe) == 2 else probe
+    pages = jax.eval_shape(lambda: P.init_pages(dm, num_blocks, bs))
+
+    # -- prefill stage (traced at one representative bucket) ---------------
+    mkp = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "paged_prefill", detail, msg
+    )
+    prefill = P.make_paged_prefill_fn(dm)
+    ids = jax.ShapeDtypeStruct((1, max_len), jnp.int32)
+    length = jax.ShapeDtypeStruct((), jnp.int32)
+    block_row = jax.ShapeDtypeStruct((blocks_per_slot,), jnp.int32)
+    closed = jax.make_jaxpr(prefill)(params, pages, ids, length, block_row)
+    findings += _callback_f64_findings(closed, mkp, "paged prefill stage")
+    _tok, _logits, prefill_pages = jax.eval_shape(
+        prefill, params, pages, ids, length, block_row
+    )
+    findings += _hash_stable(
+        mkp, prefill, closed,
+        (params, prefill_pages, ids, length, block_row),
+        "paged prefill", "signature-hash",
+    )
+
+    # -- decode stage ------------------------------------------------------
+    mkd = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "paged_decode", detail, msg
+    )
+    decode = P.make_paged_decode_fn(dm)
+    table = jax.ShapeDtypeStruct((slots, blocks_per_slot), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    closed = jax.make_jaxpr(decode)(params, pages, table, tokens, positions)
+    findings += _callback_f64_findings(closed, mkd, "paged decode stage")
+    out_tokens, out_pages = jax.eval_shape(
+        decode, params, pages, table, tokens, positions
+    )
+    findings += _hash_stable(
+        mkd, decode, closed,
+        (params, out_pages, table, out_tokens, positions),
+        "paged decode", "signature-hash",
+    )
+    for stage, mk, out in (
+        ("prefill", mkp, prefill_pages),
+        ("decode", mkd, out_pages),
+    ):
+        findings += _cache_drift(
+            mk, pages, out, f"the paged {stage} stage's page pytree",
+            "pages-drift",
+            "the pool is one fixed allocation for the engine's life — "
+            "donation and the jit cache both break",
         )
     return findings
 
 
 def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
     """All jaxpr contracts for one config (incl. the serving decode step
-    on causal-LM configs)."""
+    and BOTH paged serving stages on causal-LM configs)."""
     from consensusml_tpu import configs
 
     bundle = configs.build(name, scale=scale)
     findings = _check_step_jaxpr(name, bundle)
     findings.extend(_check_collective_count(name, bundle))
     findings.extend(_check_decode_jaxpr(name, bundle))
+    findings.extend(_check_paged_stage_jaxprs(name, bundle))
     return findings
 
 

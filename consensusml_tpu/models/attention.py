@@ -19,6 +19,8 @@ __all__ = [
     "blockwise_attention",
     "cached_attention",
     "update_kv_cache",
+    "paged_update_kv_cache",
+    "gather_paged_kv",
     "apply_rope",
     "rope_frequencies",
 ]
@@ -252,6 +254,65 @@ def update_kv_cache(
         jnp.asarray(v[:, 0], cache["v"].dtype)
     )
     return k_cache, v_cache, positions + 1
+
+
+def paged_update_kv_cache(
+    cache: dict[str, jax.Array],
+    k: jax.Array,  # (S, 1, H, D) — the decode step's single new key per slot
+    v: jax.Array,  # (S, 1, H, D)
+    block_table: jax.Array,  # (S, blocks_per_slot) physical block ids
+    positions: jax.Array,  # (S,) per-slot token index
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Write one decode step's K/V into a PAGED block pool.
+
+    ``cache`` holds ``{"k": (N, bs, H, D), "v": (N, bs, H, D)}`` — N
+    physical blocks of ``bs`` tokens each, shared by every slot. A slot's
+    logical position ``p`` maps through its block-table row:
+    ``physical = block_table[s, p // bs]``, ``offset = p % bs``. The
+    scatter indices are computed INSIDE the jit (ints on device, no host
+    round-trip), so the compiled decode step is position-oblivious — the
+    pool engine's zero-recompile contract.
+
+    Free lanes write into physical block 0, the reserved TRASH block the
+    pool never allocates (their table rows are all-zero); active lanes
+    write into blocks they own exclusively, so no scatter can corrupt
+    another slot's live tokens. Returns ``(k_pages, v_pages, lengths)``
+    with ``lengths = positions + 1`` for :func:`gather_paged_kv` +
+    :func:`cached_attention`.
+    """
+    bs = cache["k"].shape[1]
+    rows = jnp.arange(k.shape[0])
+    phys = block_table[rows, positions // bs]
+    off = positions % bs
+    k_pages = cache["k"].at[phys, off].set(jnp.asarray(k[:, 0], cache["k"].dtype))
+    v_pages = cache["v"].at[phys, off].set(jnp.asarray(v[:, 0], cache["v"].dtype))
+    return k_pages, v_pages, positions + 1
+
+
+def gather_paged_kv(
+    k_pages: jax.Array,  # (N, bs, H, D)
+    v_pages: jax.Array,  # (N, bs, H, D)
+    block_table: jax.Array,  # (S, blocks_per_slot)
+) -> tuple[jax.Array, jax.Array]:
+    """Assemble each slot's logical KV view from its block-table row.
+
+    One gather per tensor: ``pages[block_table]`` is ``(S, nb, bs, H, D)``
+    which reshapes to the ``(S, T, H, D)`` layout
+    :func:`cached_attention` expects (``T = nb * bs``; when the block
+    size divides ``max_len`` this is EXACTLY the per-slot cache shape, so
+    the attention math — and its reduction order — is bit-identical to
+    the non-paged path). Rows past a slot's length gather whatever block
+    the table names (trash, or a block's not-yet-overwritten tail);
+    the length mask zeroes their probability exactly, so the garbage
+    never contributes. The gather materializes the view transiently
+    inside the step; the RESIDENT cache stays the block pool, bounded by
+    total live tokens rather than ``num_slots * max_len``.
+    """
+    s, nb = block_table.shape
+    bs, h, d = k_pages.shape[1:]
+    k = k_pages[block_table].reshape(s, nb * bs, h, d)
+    v = v_pages[block_table].reshape(s, nb * bs, h, d)
+    return k, v
 
 
 def cached_attention(

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from consensusml_tpu.models.attention import (
     cached_attention,
     dot_product_attention,
+    gather_paged_kv,
+    paged_update_kv_cache,
     update_kv_cache,
 )
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
@@ -82,13 +84,24 @@ class _DecoderBlock(nn.Module):
         cache=None,
         positions=None,
         return_kv: bool = False,
+        block_table=None,
     ):
         c = self.config
         d_head = c.hidden // c.heads
         y = _layer_norm(c, "ln_1")(x)
         qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        if cache is not None:
+        if cache is not None and block_table is not None:
+            # paged decode step: the cache is a shared block pool; this
+            # slot's logical view assembles by block-table gather
+            # (serve/pool/ paged-KV path)
+            k_pages, v_pages, lengths = paged_update_kv_cache(
+                cache, k, v, block_table, positions
+            )
+            kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+            attn = cached_attention(q, kg, vg, lengths=lengths, dtype=c.dtype)
+            new_cache = {"k": k_pages, "v": v_pages}
+        elif cache is not None:
             # decode step: write this token's K/V into the slot cache and
             # attend over the valid prefix (serve/ KV-cache path)
             k_cache, v_cache, lengths = update_kv_cache(cache, k, v, positions)
@@ -125,6 +138,7 @@ class GPT2LM(nn.Module):
         positions: jax.Array | None = None,
         kv_cache: list | None = None,
         return_kv: bool = False,
+        block_table: jax.Array | None = None,
     ):
         """Logits (f32) by default; ``return_hidden=True`` returns the
         pre-head states (post final-LN, model dtype) instead — the
@@ -136,13 +150,17 @@ class GPT2LM(nn.Module):
         prefill cache insertion; ``kv_cache`` (a per-layer list of
         ``{"k", "v"}`` slot caches) with ``positions`` ((B,) per-slot
         token index) runs one single-token decode step against the cache
-        and returns ``(logits, new_kv_cache)``. The two are mutually
-        exclusive; the training/eval path passes neither and is
+        and returns ``(logits, new_kv_cache)``. With ``block_table`` the
+        per-layer dicts are PAGED block pools instead of per-slot rows
+        (:mod:`consensusml_tpu.serve.pool`). kv_cache and return_kv are
+        mutually exclusive; the training/eval path passes neither and is
         unchanged.
         """
         c = self.config
         if kv_cache is not None and return_kv:
             raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
+        if block_table is not None and kv_cache is None:
+            raise ValueError("block_table requires kv_cache (paged decode)")
         b, s = input_ids.shape
         if kv_cache is not None and s != 1:
             raise ValueError(f"decode steps are single-token, got seq len {s}")
@@ -165,7 +183,10 @@ class GPT2LM(nn.Module):
         for i in range(c.layers):
             blk = block(c, name=f"h_{i}")
             if kv_cache is not None:
-                x, layer_cache = blk(x, deterministic, kv_cache[i], positions)
+                x, layer_cache = blk(
+                    x, deterministic, kv_cache[i], positions,
+                    block_table=block_table,
+                )
                 new_caches.append(layer_cache)
             elif return_kv:
                 x, kv = blk(x, deterministic, None, None, True)

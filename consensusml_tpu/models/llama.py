@@ -26,6 +26,8 @@ from consensusml_tpu.models.attention import (
     apply_rope,
     cached_attention,
     dot_product_attention,
+    gather_paged_kv,
+    paged_update_kv_cache,
     rope_frequencies,
     update_kv_cache,
 )
@@ -125,6 +127,7 @@ class _LlamaBlock(nn.Module):
         cache=None,
         positions=None,
         return_kv: bool = False,
+        block_table=None,
     ):
         c = self.config
         d = c.head_dim
@@ -140,7 +143,19 @@ class _LlamaBlock(nn.Module):
         q = apply_rope(q, rope_table, pos2d)
         k = apply_rope(k, rope_table, pos2d)
         rep = c.heads // c.kv_heads
-        if cache is not None:
+        if cache is not None and block_table is not None:
+            # paged decode: block-pool pages store pre-repeat (kv_heads)
+            # rows; GQA expansion happens on the gathered view
+            k_pages, v_pages, lengths = paged_update_kv_cache(
+                cache, k, v, block_table, positions
+            )
+            new_cache = {"k": k_pages, "v": v_pages}
+            kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+            if rep != 1:
+                kg = jnp.repeat(kg, rep, axis=2)
+                vg = jnp.repeat(vg, rep, axis=2)
+            attn = cached_attention(q, kg, vg, lengths=lengths, dtype=c.dtype)
+        elif cache is not None:
             # decode: cache stores PRE-repeat (kv_heads) rows — GQA
             # expansion happens on the read, so the cache stays small
             k_cache, v_cache, lengths = update_kv_cache(cache, k, v, positions)
@@ -185,14 +200,18 @@ class LlamaLM(nn.Module):
         positions: jax.Array | None = None,
         kv_cache: list | None = None,
         return_kv: bool = False,
+        block_table: jax.Array | None = None,
     ):
         """Serving hooks mirror :class:`~consensusml_tpu.models.gpt2.GPT2LM`:
         ``return_kv=True`` also returns per-layer pre-repeat ``(k, v)``
         for prefill insertion; ``kv_cache`` + ``positions`` runs one
-        single-token decode step. The training path passes neither."""
+        single-token decode step (against paged block pools when
+        ``block_table`` is given). The training path passes neither."""
         c = self.config
         if kv_cache is not None and return_kv:
             raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
+        if block_table is not None and kv_cache is None:
+            raise ValueError("block_table requires kv_cache (paged decode)")
         if kv_cache is not None and input_ids.shape[1] != 1:
             raise ValueError(
                 f"decode steps are single-token, got seq len {input_ids.shape[1]}"
@@ -203,7 +222,10 @@ class LlamaLM(nn.Module):
         for i in range(c.layers):
             blk = _LlamaBlock(c, name=f"layer_{i}")
             if kv_cache is not None:
-                x, layer_cache = blk(x, rope_table, kv_cache[i], positions)
+                x, layer_cache = blk(
+                    x, rope_table, kv_cache[i], positions,
+                    block_table=block_table,
+                )
                 new_caches.append(layer_cache)
             elif return_kv:
                 x, kv = blk(x, rope_table, None, positions, True)

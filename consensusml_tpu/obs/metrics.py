@@ -41,6 +41,7 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_LINK_LATENCY_BUCKETS",
     "DEFAULT_ROUND_COUNT_BUCKETS",
+    "DEFAULT_SLO_BUCKETS",
 ]
 
 # round latencies span ~1 ms (smoke MLP on CPU) to minutes (first-round
@@ -55,6 +56,15 @@ DEFAULT_LATENCY_BUCKETS = (
 DEFAULT_LINK_LATENCY_BUCKETS = (
     1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
     1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+# serving SLOs (TTFT, inter-token gaps, per-stage serving latencies):
+# decode steps run sub-millisecond on real chips, so the request-path
+# families need resolution DEFAULT_LATENCY_BUCKETS does not have below
+# 1 ms; the top stays low — a 30 s serving latency is already an outage
+DEFAULT_SLO_BUCKETS = (
+    1e-4, 2.5e-4, 5e-4, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 )
 
 # small-integer round counts (gossip-bootstrap length, recovery windows):

@@ -7,13 +7,19 @@ evaluator and elastic resume use — into a single serving artifact
 (:mod:`.export`), which a KV-cache decode engine (:mod:`.decode`) serves
 through a slot-based continuous batcher (:mod:`.batcher`,
 :class:`.engine.Engine`) and an optional threaded socket front-end
-(:mod:`.server`). The whole request path is SLO-instrumented
-(``consensusml_serve_*`` metric family + spans, docs/serving.md) and the
-decode step carries its own cml-check jaxpr contract: no host callbacks
-and ZERO recompiles across steady-state decode steps.
+(:mod:`.server`). KV memory lives in a paged block pool by default
+(:mod:`.pool` — slot occupancy bounded by live tokens, disaggregated
+prefill/decode stages, drain-free hot checkpoint swap via the
+artifact's ``generation`` counter); the PR 5 per-slot layout stays as
+``ServeConfig(kv_impl="slot")``, the bit-exact parity baseline. The
+whole request path is SLO-instrumented (``consensusml_serve_*`` /
+``consensusml_pool_*`` metric families + spans, docs/serving.md) and
+every serving stage carries its own cml-check jaxpr contract: no host
+callbacks and ZERO recompiles across steady-state steps.
 """
 
 from consensusml_tpu.serve.export import (  # noqa: F401
+    bump_generation,
     export_serving,
     load_serving,
     serving_meta,

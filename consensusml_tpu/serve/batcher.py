@@ -33,6 +33,7 @@ class GenResult:
     ttft_s: float  # arrival -> first token
     latency_s: float  # arrival -> completion
     prompt_len: int
+    generation: int = 0  # artifact generation that finished the stream
 
 
 class RequestHandle:
@@ -96,6 +97,9 @@ class Slot:
     generated: int = 1  # prefill produced token #1
     ttft_s: float = 0.0
     last_token_t: float = 0.0
+    # artifact generation currently decoding this lane; a hot swap flips
+    # every resident slot's tag between two decode steps (serve/pool/)
+    generation: int = 0
 
 
 class SlotTable:

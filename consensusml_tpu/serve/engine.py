@@ -1,29 +1,48 @@
 """The serving engine: one decode loop thread over a slot table.
 
-``Engine`` owns the three compiled program families from
-:mod:`consensusml_tpu.serve.decode`, the KV slot caches, and a single
-scheduler thread that interleaves prefill admissions with in-flight
-decode (continuous batching, :mod:`consensusml_tpu.serve.batcher`).
-Clients — the in-process API, the socket front-end, loadgen — only touch
-the bounded submit queue and per-request handles; all device work stays
-on the one engine thread, so the jit caches, the cache pytree, and the
+``Engine`` owns the compiled program families (paged stages from
+:mod:`consensusml_tpu.serve.pool.stages` by default, the PR 5 per-slot
+path from :mod:`consensusml_tpu.serve.decode` as ``kv_impl="slot"``),
+the KV memory (block pool or slot caches), and a single scheduler
+thread that interleaves prefill admissions with in-flight decode
+(continuous batching, :mod:`consensusml_tpu.serve.batcher`). Clients —
+the in-process API, the socket front-end, loadgen — only touch the
+bounded submit queue and per-request handles; all device work stays on
+the one engine thread, so the jit caches, the cache pytree, and the
 slot table need no locking.
 
+Paged mode adds three behaviors on top of the PR 5 loop
+(:mod:`consensusml_tpu.serve.pool`):
+
+- slot occupancy is bounded by total live tokens (the block pool), so
+  more lanes than ``HBM / max_len`` can be in flight under a heavy-tail
+  length mix; on block exhaustion the youngest stream is preempted by
+  RECOMPUTE (its blocks free, its prompt+generated-so-far re-enqueues at
+  the head of the line — tokens already streamed stand, nothing drops);
+- prefill admission is budgeted per tick (:class:`.pool.stages.
+  AdmissionScheduler`): the decode step runs every tick, so a burst of
+  long prompts spreads over ticks instead of stalling every stream;
+- :meth:`watch` arms the drain-free hot swap: a new artifact generation
+  flips params (and every resident slot's generation tag) between two
+  decode steps with zero dropped streams and zero recompiles.
+
 SLO instrumentation (docs/serving.md, docs/observability.md): every
-request path stage lands on the ``consensusml_serve_*`` metric family
-(TTFT, inter-token latency, queue depth, batch occupancy, tokens/s) and
+request path stage lands on the ``consensusml_serve_*`` /
+``consensusml_pool_*`` metric families (TTFT, inter-token latency, queue
+depth, batch occupancy, block occupancy, evictions, swaps, tokens/s) and
 ``serve.prefill`` / ``serve.decode_step`` spans.
 
 The steady-state contract: after :meth:`warmup` (one decode compile +
 one prefill compile per prompt bucket), serving ANY admission order of
 ANY mix of prompt lengths performs ZERO further compiles —
 :meth:`compile_counts` exposes the jit cache sizes so tests and the
-bench assert it, and cml-check's decode jaxpr contract pins the
-step-over-step program hash.
+bench assert it, and cml-check's jaxpr contracts pin the
+step-over-step program hash per stage.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -46,6 +65,11 @@ class ServeConfig:
     max_new_tokens: int = 16  # default per-request generation cap
     eos_id: int | None = None  # None: generation stops on the token cap
     idle_wait_s: float = 0.02  # scheduler block when nothing is in flight
+    # -- paged KV pool (serve/pool/; "slot" = the PR 5 per-slot rows) ----
+    kv_impl: str = "paged"  # "paged" | "slot"
+    block_size: int = 8  # tokens per physical KV block (must divide max_len)
+    num_blocks: int = 0  # pool size; 0 = num_slots * max_len/block_size + 1
+    prefill_budget: int = 0  # prefill tokens per tick; 0 = one max_len bucket
 
 
 class Engine:
@@ -74,16 +98,58 @@ class Engine:
             )
         if cfg.num_slots < 1:
             raise ValueError(f"num_slots must be positive, got {cfg.num_slots}")
-        self.buckets = D.prefill_buckets(self.max_len)
+        if cfg.kv_impl not in ("paged", "slot"):
+            raise ValueError(
+                f"kv_impl must be 'paged' or 'slot', got {cfg.kv_impl!r}"
+            )
+        self.paged = cfg.kv_impl == "paged"
         self._params = jax.device_put(params)
-        self._cache = D.init_cache(dm, cfg.num_slots, self.max_len)
-        self._prefill_fn = D.make_prefill_fn(dm)
-        self._decode_fn = D.make_decode_fn(dm)
+        if self.paged:
+            from consensusml_tpu.serve import pool as P
+
+            # paged buckets start at the block size so every bucket is
+            # block-aligned (prefill scatters whole blocks)
+            self.buckets = D.prefill_buckets(
+                self.max_len, smallest=max(8, cfg.block_size)
+            )
+            misaligned = [b for b in self.buckets if b % cfg.block_size]
+            if misaligned:
+                raise ValueError(
+                    f"block_size {cfg.block_size} does not divide prefill "
+                    f"bucket(s) {misaligned} (buckets "
+                    f"{list(self.buckets)} for max_len {self.max_len}); "
+                    "the prefill scatter chunks whole blocks — use a "
+                    "power-of-two block_size, or one >= 8 that divides "
+                    "max_len"
+                )
+            self._pool = P.BlockPool(
+                cfg.num_slots, self.max_len, cfg.block_size, cfg.num_blocks
+            )
+            self._pages = P.init_pages(
+                dm, self._pool.num_blocks, cfg.block_size
+            )
+            self._prefill_fn = P.make_paged_prefill_fn(dm)
+            self._decode_fn = P.make_paged_decode_fn(dm)
+            self._sched = P.AdmissionScheduler(
+                cfg.prefill_budget or self.max_len
+            )
+        else:
+            self.buckets = D.prefill_buckets(self.max_len)
+            self._pool = None
+            self._cache = D.init_cache(dm, cfg.num_slots, self.max_len)
+            self._prefill_fn = D.make_prefill_fn(dm)
+            self._decode_fn = D.make_decode_fn(dm)
+            self._sched = None
         self._score_fn = D.make_score_fn(dm)
         self._Request, self._RequestHandle = Request, RequestHandle
 
         self._queue: "queue.Queue" = queue.Queue(cfg.queue_depth)
+        # evicted continuations re-enter here, ahead of fresh arrivals
+        # (engine thread appends/pops; submit's lost-race sweep may drain)
+        self._requeue: "collections.deque" = collections.deque()
         self._table = SlotTable(cfg.num_slots)
+        self._generation = 0  # artifact generation (load_engine sets it)
+        self._watcher = None
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._drained = threading.Event()
@@ -103,16 +169,21 @@ class Engine:
         self._m_tokens = reg.counter(
             "consensusml_serve_tokens_total", "tokens generated (prefill + decode)"
         )
+        from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+
         self._m_ttft = reg.histogram(
             "consensusml_serve_ttft_seconds",
             "time to first token: arrival -> first generated token",
+            buckets=DEFAULT_SLO_BUCKETS,
         )
         self._m_intertoken = reg.histogram(
             "consensusml_serve_intertoken_seconds",
             "per-decode-step latency (== inter-token gap for resident slots)",
+            buckets=DEFAULT_SLO_BUCKETS,
         )
         self._m_prefill = reg.histogram(
-            "consensusml_serve_prefill_seconds", "prefill forward wall time"
+            "consensusml_serve_prefill_seconds", "prefill forward wall time",
+            buckets=DEFAULT_SLO_BUCKETS,
         )
         self._m_queue = reg.gauge(
             "consensusml_serve_queue_depth", "requests waiting for a slot"
@@ -125,21 +196,45 @@ class Engine:
             "consensusml_serve_tokens_per_sec",
             "decode throughput: active slots / step wall time (sampled)",
         )
+        self._m_generation = reg.gauge(
+            "consensusml_serve_generation",
+            "artifact generation currently serving",
+        )
+        self._m_swaps = reg.counter(
+            "consensusml_serve_swaps_total",
+            "drain-free hot swaps applied (params flipped between steps)",
+        )
+        self._m_evictions = reg.counter(
+            "consensusml_pool_evictions_total",
+            "streams preempted by recompute on block-pool exhaustion",
+        )
+        if self.paged:
+            self._m_blocks_free = reg.gauge(
+                "consensusml_pool_blocks_free",
+                "free physical KV blocks (trash block excluded)",
+            )
+            self._m_block_occ = reg.gauge(
+                "consensusml_pool_block_occupancy",
+                "allocated blocks / usable blocks (sampled per step)",
+            )
+            self._m_blocks_free.set(self._pool.free_blocks)
+            self._m_block_occ.set(0.0)
 
         # host-side SLO accumulators for bench/loadgen percentiles —
         # BOUNDED rings (a serving process lives for weeks; the Prometheus
         # histograms carry the full-lifetime distributions, these lists
         # only feed stats() percentiles over the recent window)
-        import collections
-
         self._ttfts: "collections.deque[float]" = collections.deque(maxlen=4096)
         self._step_times: "collections.deque[float]" = collections.deque(
             maxlen=4096
         )
         self._occupancy_sum = 0.0
+        self._block_occupancy_sum = 0.0
         self._decode_steps = 0
         self._tokens_out = 0
         self._decode_time_s = 0.0
+        self._evictions = 0
+        self._swaps = 0
         self._error: BaseException | None = None
 
         self._thread = threading.Thread(
@@ -222,21 +317,93 @@ class Engine:
         shapes (jit caches key on shape, so the executables are shared
         with the live path) — the engine thread may already be serving,
         and warmup must not mutate (or donate away) the cache it is
-        using. Transient cost: one extra cache's worth of memory.
+        using. Transient cost: one extra cache's worth of memory. In
+        paged mode the throwaway pool's all-zero block table routes every
+        warmup write into the trash block.
         """
         import jax.numpy as jnp
 
         from consensusml_tpu.serve import decode as D
 
+        toks = jnp.zeros((self.config.num_slots,), jnp.int32)
+        if self.paged:
+            from consensusml_tpu.serve import pool as P
+
+            bs = self.config.block_size
+            pages = P.init_pages(self._dm, self._pool.num_blocks, bs)
+            for b in buckets if buckets is not None else self.buckets:
+                ids = jnp.zeros((1, b), jnp.int32)
+                _tok, _logits, pages = self._prefill_fn(
+                    self._params, pages, ids, jnp.int32(1),
+                    jnp.zeros((b // bs,), jnp.int32),
+                )
+            table = jnp.zeros(
+                (self.config.num_slots, self._pool.blocks_per_slot),
+                jnp.int32,
+            )
+            self._decode_fn(
+                self._params, pages, table, toks, jnp.zeros_like(toks)
+            )
+            return self.compile_counts()
         cache = D.init_cache(self._dm, self.config.num_slots, self.max_len)
         for b in buckets if buckets is not None else self.buckets:
             ids = jnp.zeros((1, b), jnp.int32)
             _tok, _logits, cache = self._prefill_fn(
                 self._params, cache, ids, jnp.int32(1), jnp.int32(0)
             )
-        toks = jnp.zeros((self.config.num_slots,), jnp.int32)
         self._decode_fn(self._params, cache, toks, jnp.zeros_like(toks))
         return self.compile_counts()
+
+    def watch(self, path: str, poll_s: float = 0.25):
+        """Arm the drain-free hot swap: poll ``path`` for a new artifact
+        generation, stage it off-thread, flip between decode steps
+        (:mod:`consensusml_tpu.serve.pool.hotswap`). Returns the watcher."""
+        from consensusml_tpu.serve.pool import GenerationWatcher
+
+        if self._watcher is not None:
+            raise RuntimeError("engine is already watching an artifact dir")
+        self._watcher = GenerationWatcher(
+            path, current_generation=self._generation, poll_s=poll_s
+        )
+        return self._watcher
+
+    @property
+    def generation(self) -> int:
+        """Artifact generation currently serving (0 = direct params)."""
+        return self._generation
+
+    def _maybe_swap(self) -> None:
+        """Engine-thread flip of a staged generation (between steps).
+
+        The staged tree must match the live tree leaf-for-leaf — same
+        structure, shapes, dtypes — or the compiled programs would
+        recompile (or worse, serve garbage); a mismatch is rejected and
+        counted, and the engine keeps serving the current generation.
+        """
+        if self._watcher is None:
+            return
+        sw = self._watcher.take()
+        if sw is None:
+            return
+        import jax
+
+        old, new = jax.tree.leaves(self._params), jax.tree.leaves(sw.params)
+        ok = jax.tree.structure(self._params) == jax.tree.structure(
+            sw.params
+        ) and all(
+            a.shape == b.shape and a.dtype == b.dtype
+            for a, b in zip(old, new)
+        )
+        if not ok:
+            self._watcher.reject(sw)  # roll back: a fixed same-gen
+            return  # re-export must be stageable
+        self._params = sw.params
+        self._generation = sw.generation
+        for _i, slot in self._table.active:
+            slot.generation = sw.generation
+        self._swaps += 1
+        self._m_swaps.inc()
+        self._m_generation.set(sw.generation)
 
     def compile_counts(self) -> dict[str, int]:
         """Jit-cache entry counts per program family — the
@@ -263,6 +430,8 @@ class Engine:
             self.drain(timeout)
         self._stop.set()
         self._thread.join(timeout=5.0)
+        if self._watcher is not None:
+            self._watcher.stop()
 
     def __enter__(self) -> "Engine":
         return self
@@ -278,7 +447,8 @@ class Engine:
             float(np.percentile(list(xs), q)) if xs else float("nan")
         )
         decode_time = self._decode_time_s
-        return {
+        out = {
+            "kv_impl": self.config.kv_impl,
             "tokens_out": self._tokens_out,
             "decode_steps": self._decode_steps,
             "ttft_p50_ms": 1e3 * pct(self._ttfts, 50),
@@ -293,8 +463,24 @@ class Engine:
             "decode_tokens_per_sec": (
                 self._tokens_out / decode_time if decode_time > 0 else 0.0
             ),
+            "generation": self._generation,
+            "swaps": self._swaps,
+            "evictions": self._evictions,
             "compile_counts": self.compile_counts(),
         }
+        if self.paged:
+            out["pool"] = {
+                "num_blocks": self._pool.num_blocks,
+                "block_size": self._pool.block_size,
+                "usable_blocks": self._pool.usable_blocks,
+                "free_blocks": self._pool.free_blocks,
+                "mean_block_occupancy": (
+                    self._block_occupancy_sum / self._decode_steps
+                    if self._decode_steps
+                    else 0.0
+                ),
+            }
+        return out
 
     # -- engine thread ------------------------------------------------------
 
@@ -302,18 +488,27 @@ class Engine:
         q = self._queue
         try:
             while not self._stop.is_set():
+                self._maybe_swap()  # flip a staged generation between steps
+                if self._sched is not None:
+                    self._sched.start_tick()
                 self._admit_waiting()
                 if self._table.num_active:
                     self._decode_step()
                     continue
-                if self._draining.is_set() and q.empty():
+                if self._draining.is_set() and q.empty() and not self._requeue:
                     break
+                if self._requeue:  # deferred by budget; retry next tick
+                    continue
                 try:
                     req = q.get(timeout=self.config.idle_wait_s)
                 except queue.Empty:
                     continue
                 self._m_queue.set(q.qsize())
-                self._admit(req)
+                # route through _admit_waiting's capacity/budget gate
+                # next iteration — a direct _admit here would bypass the
+                # pool's can_admit check and lean on a hidden
+                # pool-empty-when-idle invariant
+                self._requeue.append(req)
         except BaseException as e:
             # a device error mid-serving (OOM compiling a bucket, bad
             # params) must not leave clients parked on silent handles:
@@ -342,6 +537,12 @@ class Engine:
         ``_drained`` is set nothing services the queue, so cancelling is
         always correct, and the thread-safe ``get_nowait`` hands each
         request to exactly one canceller."""
+        while self._requeue:
+            try:
+                req = self._requeue.popleft()
+            except IndexError:
+                break
+            self._finish_handle(req, req.handle._all, "cancelled")
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -349,13 +550,36 @@ class Engine:
                 return
             self._finish_handle(req, [], "cancelled")
 
+    def _pop_waiting(self):
+        """Next admission candidate: evicted continuations first (their
+        tokens are already streaming to a client), then fresh arrivals."""
+        if self._requeue:
+            return self._requeue.popleft()
+        req = self._queue.get_nowait()
+        self._m_queue.set(self._queue.qsize())
+        return req
+
     def _admit_waiting(self) -> None:
         while self._table.free_slot() is not None:
             try:
-                req = self._queue.get_nowait()
+                req = self._pop_waiting()
             except queue.Empty:
                 return
-            self._m_queue.set(self._queue.qsize())
+            if self.paged:
+                from consensusml_tpu.serve.pool import blocks_for_tokens
+
+                bucket = self._bucket(len(req.ids))
+                need = blocks_for_tokens(
+                    len(req.ids) + 1, self.config.block_size
+                )
+                # defer (don't drop) when this tick's prefill budget is
+                # spent or the pool can't hold the prompt yet; the
+                # request keeps its place at the head of the line
+                if not self._pool.can_admit(need) or not self._sched.try_admit(
+                    bucket
+                ):
+                    self._requeue.appendleft(req)
+                    return
             self._admit(req)
 
     def _bucket(self, n: int) -> int:
@@ -385,41 +609,124 @@ class Engine:
         assert idx is not None, "admission with no free slot"
         n = len(req.ids)
         bucket = self._bucket(n)
+        # an evicted continuation re-prefills prompt + generated-so-far;
+        # its TTFT already happened and its token count keeps running
+        already = len(req.handle._all)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.ids
         t0 = time.perf_counter()
         with self._tracer.span("serve.prefill", bucket=bucket, slot=idx):
-            tok_dev, _logits, self._cache = self._prefill_fn(
-                self._params,
-                self._cache,
-                jnp.asarray(ids),
-                jnp.int32(n),
-                jnp.int32(idx),
-            )
+            if self.paged:
+                from consensusml_tpu.serve.pool import blocks_for_tokens
+
+                bs = self.config.block_size
+                # cover the prompt AND the first decode write (position n)
+                self._pool.alloc(idx, blocks_for_tokens(n + 1, bs))
+                try:
+                    tok_dev, _logits, self._pages = self._prefill_fn(
+                        self._params,
+                        self._pages,
+                        jnp.asarray(ids),
+                        jnp.int32(n),
+                        jnp.asarray(self._pool.block_row(idx, bucket // bs)),
+                    )
+                except BaseException:
+                    self._pool.release(idx)  # no leaked blocks on a raise
+                    raise
+            else:
+                tok_dev, _logits, self._cache = self._prefill_fn(
+                    self._params,
+                    self._cache,
+                    jnp.asarray(ids),
+                    jnp.int32(n),
+                    jnp.int32(idx),
+                )
             tok = int(tok_dev)  # device fence: the first token is real now
         now = time.perf_counter()
         self._m_prefill.observe(now - t0)
         ttft = now - req.arrival_t
-        self._m_ttft.observe(ttft)
-        self._ttfts.append(ttft)
+        if already == 0:
+            self._m_ttft.observe(ttft)
+            self._ttfts.append(ttft)
+            req.handle._ttft_s = ttft
+        else:  # continuation: the stream's real TTFT already happened
+            ttft = getattr(req.handle, "_ttft_s", 0.0)
         req.handle._emit(tok)
         self._m_tokens.inc()
         self._tokens_out += 1
-        if req.max_new_tokens == 1 or tok == self.config.eos_id:
+        if already + 1 >= req.max_new_tokens or tok == self.config.eos_id:
             reason = "eos" if tok == self.config.eos_id else "max_tokens"
+            if self.paged:
+                self._pool.release(idx)
             self._finish_handle(req, req.handle._all, reason, ttft=ttft)
             return
         self._table.occupy(
             idx,
             Slot(
-                request=req, next_pos=n, pending=tok, generated=1,
-                ttft_s=ttft, last_token_t=now,
+                request=req, next_pos=n, pending=tok, generated=already + 1,
+                ttft_s=ttft, last_token_t=now, generation=self._generation,
             ),
         )
+
+    def _youngest_active(self) -> int:
+        """Eviction victim: the most recently arrived stream (it has the
+        least sunk work to recompute and the fewest tokens streamed)."""
+        return max(
+            self._table.active,
+            key=lambda t: (t[1].request.arrival_t, t[0]),
+        )[0]
+
+    def _evict(self, idx: int) -> None:
+        """Recompute-preemption: free ``idx``'s blocks and re-enqueue its
+        stream as prompt + everything generated so far. The re-prefill
+        seeds the continuation's cache and next token, so the client's
+        stream continues — tokens already emitted stand, none drop."""
+        slot = self._table.release(idx)
+        self._pool.release(idx)
+        req = slot.request
+        # req.ids may itself be a continuation; the first prompt_len ids
+        # are always the original prompt
+        req.ids = list(req.ids[: req.handle.prompt_len]) + list(
+            req.handle._all
+        )
+        # head of the line, AHEAD of any budget-deferred fresh arrival
+        # (its tokens are already streaming to a client; a fresh request
+        # admitted first could consume the very blocks it needs)
+        self._requeue.appendleft(req)
+        self._evictions += 1
+        self._m_evictions.inc()
+
+    def _grow_blocks(self) -> None:
+        """Before a paged step: give every lane whose next write crosses
+        into a new block that block, evicting youngest-first when the
+        pool is exhausted (the lane needing the block may itself be the
+        youngest — then it preempts itself and re-enters via requeue)."""
+        bs = self.config.block_size
+        for i, _slot in self._table.active:
+            while True:
+                slot = self._table.slots[i]
+                if slot is None:
+                    break  # evicted while resolving an earlier lane
+                if slot.next_pos // bs < len(self._pool.owned(i)):
+                    break  # this step's write block is already owned
+                from consensusml_tpu.serve.pool import NoFreeBlocks
+
+                try:
+                    self._pool.extend(i, 1)
+                    break
+                except NoFreeBlocks:
+                    victim = self._youngest_active()
+                    self._evict(victim)
+                    if victim == i:
+                        break
 
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
+        if self.paged:
+            self._grow_blocks()
+            if not self._table.num_active:  # everything preempted
+                return
         active = self._table.active
         s = self.config.num_slots
         tokens = np.zeros((s,), np.int32)
@@ -429,9 +736,18 @@ class Engine:
             positions[i] = slot.next_pos
         t0 = time.perf_counter()
         with self._tracer.span("serve.decode_step", active=len(active)):
-            next_dev, self._cache = self._decode_fn(
-                self._params, self._cache, jnp.asarray(tokens), jnp.asarray(positions)
-            )
+            if self.paged:
+                next_dev, self._pages = self._decode_fn(
+                    self._params,
+                    self._pages,
+                    self._pool.device_table(),
+                    jnp.asarray(tokens),
+                    jnp.asarray(positions),
+                )
+            else:
+                next_dev, self._cache = self._decode_fn(
+                    self._params, self._cache, jnp.asarray(tokens), jnp.asarray(positions)
+                )
             next_toks = np.asarray(next_dev)  # device fence per step
         dt = time.perf_counter() - t0
         now = time.perf_counter()
@@ -443,6 +759,11 @@ class Engine:
         self._m_occupancy.set(len(active) / s)
         if dt > 0:
             self._m_tps.set(len(active) / dt)
+        if self.paged:
+            occ = self._pool.used_blocks / self._pool.usable_blocks
+            self._block_occupancy_sum += occ
+            self._m_block_occ.set(occ)
+            self._m_blocks_free.set(self._pool.free_blocks)
         for i, slot in active:
             tok = int(next_toks[i])
             slot.request.handle._emit(tok)
@@ -461,12 +782,17 @@ class Engine:
                 reason = "length"  # safety net; submit() validation bounds it
             if reason is not None:
                 self._table.release(i)
+                if self.paged:
+                    self._pool.release(i)
                 self._finish_handle(
                     slot.request, slot.request.handle._all, reason,
-                    ttft=slot.ttft_s,
+                    ttft=slot.ttft_s, generation=slot.generation,
                 )
 
-    def _finish_handle(self, req, tokens, reason: str, ttft: float = 0.0) -> None:
+    def _finish_handle(
+        self, req, tokens, reason: str, ttft: float = 0.0,
+        generation: int | None = None,
+    ) -> None:
         from consensusml_tpu.serve.batcher import GenResult
 
         now = time.perf_counter()
@@ -476,7 +802,10 @@ class Engine:
                 finish_reason=reason,
                 ttft_s=ttft,
                 latency_s=now - req.arrival_t,
-                prompt_len=len(req.ids),
+                prompt_len=req.handle.prompt_len,
+                generation=(
+                    self._generation if generation is None else generation
+                ),
             )
         )
         if reason != "cancelled":
@@ -493,4 +822,9 @@ def load_engine(path: str, config: ServeConfig | None = None) -> Engine:
 
     meta, params, _model_state = load_serving(path)
     bundle = configs.build(meta["config_name"], meta.get("scale", "smoke"))
-    return Engine(bundle.model, params, config)
+    engine = Engine(bundle.model, params, config)
+    # seed the hot-swap ordering key from the artifact: watch() must
+    # reject re-reads of THIS generation, not just generation 0
+    engine._generation = int(meta.get("generation", 0))
+    engine._m_generation.set(engine._generation)
+    return engine

@@ -13,16 +13,24 @@ writes:
 - ``<dir>/serve_meta.json`` — config name + scale (enough to rebuild the
   architecture via :func:`consensusml_tpu.configs.build`), the training
   round and world size the artifact came from (provenance for the
-  serving fleet's rollout logs). Written atomically, meta LAST: a
-  partial export never parses as a valid artifact.
+  serving fleet's rollout logs), and a monotonically increasing
+  ``generation`` counter — the hot-swap protocol's ordering key
+  (:mod:`consensusml_tpu.serve.pool.hotswap`): each export at the same
+  path bumps it, and readers reject a meta whose generation goes
+  backwards. Written atomically, meta LAST: a partial export never
+  parses as a valid artifact, and a reader that sees generation g+1 is
+  guaranteed to see generation g+1's model directory.
 
 ``train.py --export-serving DIR`` writes one at end of run (and at every
 ``--checkpoint-every`` boundary) so training hands off to serving
-without a manual conversion step.
+without a manual conversion step — a watching engine picks each
+generation up mid-traffic.
 """
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
 from typing import Any
@@ -32,7 +40,13 @@ import jax
 from consensusml_tpu.utils.checkpoint import replicated_scalar
 from consensusml_tpu.utils.tree import consensus_mean
 
-__all__ = ["export_serving", "load_serving", "serving_meta", "META_NAME"]
+__all__ = [
+    "export_serving",
+    "load_serving",
+    "serving_meta",
+    "bump_generation",
+    "META_NAME",
+]
 
 META_NAME = "serve_meta.json"
 _MODEL_SUBDIR = "model"
@@ -52,6 +66,31 @@ def _host_value(v: Any):
     return np.asarray(jax.device_get(v))
 
 
+def _next_generation(path: str) -> int:
+    """One past the generation already at ``path`` (0 when absent/torn):
+    repeated exports to one artifact dir count monotonically upward."""
+    try:
+        return int(serving_meta(path).get("generation", 0)) + 1
+    except ValueError:
+        return 1
+
+
+@contextlib.contextmanager
+def _generation_lock(path: str):
+    """Exclusive cross-process lock for the generation read-modify-write.
+
+    ``os.replace`` makes each meta WRITE atomic, but the increment is
+    read-then-write: a trainer export racing a ``bump_generation`` (same
+    dir, different processes) could mint the same generation twice, and
+    a watcher that staged the first would silently skip the second —
+    new weights never served. One flock per artifact dir serializes the
+    writers; readers never take it."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, ".generation.lock"), "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        yield  # unlocked by close
+
+
 def export_serving(
     path: str,
     state: Any,
@@ -59,16 +98,22 @@ def export_serving(
     config_name: str,
     scale: str = "smoke",
     round: int | None = None,
+    generation: int | None = None,
 ) -> str:
     """Collapse ``state`` (stacked TrainState) to a serving artifact.
 
     Returns the artifact directory. Safe to call repeatedly on the same
     ``path`` (checkpoint-boundary exports overwrite: latest wins, and the
-    meta rewrite is atomic so a reader never sees a torn artifact).
+    meta rewrite is atomic so a reader never sees a torn artifact). Each
+    overwrite advances ``generation`` (auto-incremented from the meta
+    already on disk unless given explicitly) — the counter a hot-swapping
+    engine orders reloads by.
     """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if generation is not None and generation < 1:
+        raise ValueError(f"generation must be >= 1, got {generation}")
     world = int(state.step.shape[0])
     if round is None:
         round = replicated_scalar(state.step)
@@ -83,19 +128,45 @@ def export_serving(
     mean = jax.tree.map(_host_value, mean)
     if jax.process_count() > 1 and jax.process_index() != 0:
         return path  # one writer; peers return the same path
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, _MODEL_SUBDIR), mean, force=True)
-    meta = {
-        "config_name": config_name,
-        "scale": scale,
-        "round": int(round),
-        "world_size": world,
-    }
+    # the lock covers decide-generation THROUGH meta write: concurrent
+    # writers (trainer export vs a bump_generation) serialize instead of
+    # minting the same generation twice
+    with _generation_lock(path):
+        if generation is None:
+            generation = _next_generation(path)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, _MODEL_SUBDIR), mean, force=True)
+        meta = {
+            "config_name": config_name,
+            "scale": scale,
+            "round": int(round),
+            "world_size": world,
+            "generation": int(generation),
+        }
+        _write_meta(path, meta)
+    return path
+
+
+def _write_meta(path: str, meta: dict[str, Any]) -> None:
     tmp = os.path.join(path, META_NAME + ".tmp")
     with open(tmp, "w") as f:
         json.dump(meta, f, indent=2)
     os.replace(tmp, os.path.join(path, META_NAME))
-    return path
+
+
+def bump_generation(path: str) -> int:
+    """Rewrite an existing artifact's meta with ``generation + 1`` (model
+    untouched, atomic). The loadgen ``--swap-every`` knob uses this to
+    exercise the hot-swap machinery under load without retraining; a
+    trainer re-export does the same thing implicitly with new weights.
+    Returns the new generation."""
+    path = os.path.abspath(path)
+    with _generation_lock(path):
+        meta = serving_meta(path)  # raises on non-artifacts
+        gen = int(meta.get("generation", 0)) + 1
+        meta["generation"] = gen
+        _write_meta(path, meta)
+    return gen
 
 
 def serving_meta(path: str) -> dict[str, Any]:

@@ -1,0 +1,50 @@
+"""Serving memory + scheduling subsystem: paged KV blocks, disaggregated
+prefill/decode stages, drain-free hot checkpoint swap.
+
+Three pieces, one contract (fixed shapes, zero recompiles after warmup,
+no host sync in the decode hot loop):
+
+- :mod:`.blocks` — the paged block pool: slot occupancy bounded by total
+  live tokens instead of ``num_slots * max_len``;
+- :mod:`.stages` — separately-jitted prefill/decode programs plus the
+  per-tick admission budget that keeps decode from waiting on long
+  prefills (TTFT p99 is the target metric);
+- :mod:`.hotswap` — generation-tagged artifact reload: the engine flips
+  to a newly exported consensus mean between decode steps with no drain
+  and no dropped streams.
+
+The engine (:class:`consensusml_tpu.serve.Engine`) runs this path by
+default (``ServeConfig.kv_impl="paged"``); the PR 5 per-slot path stays
+as ``kv_impl="slot"`` — the parity baseline the tests compare against
+bit for bit and the bench measures occupancy gains over.
+"""
+
+from consensusml_tpu.serve.pool.blocks import (  # noqa: F401
+    BlockPool,
+    NoFreeBlocks,
+    TRASH_BLOCK,
+    blocks_for_tokens,
+    init_pages,
+)
+from consensusml_tpu.serve.pool.stages import (  # noqa: F401
+    AdmissionScheduler,
+    make_paged_decode_fn,
+    make_paged_prefill_fn,
+)
+from consensusml_tpu.serve.pool.hotswap import (  # noqa: F401
+    GenerationWatcher,
+    StagedSwap,
+)
+
+__all__ = [
+    "BlockPool",
+    "NoFreeBlocks",
+    "TRASH_BLOCK",
+    "blocks_for_tokens",
+    "init_pages",
+    "AdmissionScheduler",
+    "make_paged_decode_fn",
+    "make_paged_prefill_fn",
+    "GenerationWatcher",
+    "StagedSwap",
+]

@@ -1,0 +1,238 @@
+"""Paged KV-cache block pool: fixed-shape pages + host block accounting.
+
+The PR 5 engine gives every slot a private ``(max_len, H, D)`` cache row,
+so HBM occupancy is bounded by ``num_slots * max_len`` tokens whether the
+streams are long or short. The pool replaces the rows with a SHARED set
+of fixed-shape physical blocks:
+
+- **Pages** (device): per-layer ``{"k", "v"}`` arrays of shape
+  ``(num_blocks, block_size, kv_heads, head_dim)``. One allocation for
+  the life of the engine; never reshaped, so the zero-recompile contract
+  the per-slot path pins (``analysis/jaxpr_contracts.py``) carries over
+  unchanged — the paged stages get their own contracts.
+- **Block table** (device): ``(num_slots, blocks_per_slot)`` int32 —
+  slot-logical block index → physical block id. All gather/scatter
+  indices derive from it INSIDE the jit
+  (:func:`consensusml_tpu.models.attention.paged_update_kv_cache` /
+  :func:`~consensusml_tpu.models.attention.gather_paged_kv`); the decode
+  hot loop performs zero host syncs on pool state.
+- **Free list** (host-authoritative, device-mirrored): allocation
+  decisions happen at admission / block-boundary crossings — host events
+  on host ints, off the per-token path. :class:`BlockPool` enforces the
+  invariants the tests pin: no double-allocate, no double-free, no leak
+  (free + owned always partitions the physical blocks exactly).
+
+**The trash block.** Physical block 0 is reserved and never allocated.
+Freed slots' table rows reset to 0, so the decode step's fixed-shape
+scatter (every lane writes every step, free lanes included) lands free
+lanes' garbage in the trash block instead of in pages another slot now
+owns. Garbage gathered from trash (or from an owned block's
+not-yet-written tail) sits beyond the length mask, which zeroes its
+probability exactly — same argument the per-slot path makes for stale
+rows, so slot/block reuse needs no cache clearing.
+
+Occupancy is bounded by total LIVE tokens (``(num_blocks - 1) *
+block_size``), not by ``num_slots * max_len``: with a heavy-tail length
+mix, a pool sized for the MEAN length serves far more concurrent streams
+than per-slot rows sized for the max (the bench serving section measures
+exactly this).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["BlockPool", "NoFreeBlocks", "init_pages", "blocks_for_tokens"]
+
+TRASH_BLOCK = 0  # reserved physical block; free lanes scatter here
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation; callers evict or defer."""
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Physical blocks needed to hold ``tokens`` logical positions."""
+    return -(-tokens // block_size)
+
+
+def init_pages(dm: Any, num_blocks: int, block_size: int) -> list[dict]:
+    """Per-layer ``{"k", "v"}`` page pools,
+    ``(num_blocks, block_size, kv_heads, head_dim)`` in the model's
+    compute dtype (Llama-GQA pages pre-repeat heads, like the slot
+    cache). ~2 * layers * num_blocks * block_size * kv_heads * d *
+    itemsize bytes total — sized by live tokens, not slots * max_len."""
+    import jax.numpy as jnp
+
+    shape = (num_blocks, block_size, dm.kv_heads, dm.head_dim)
+    return [
+        {
+            "k": jnp.zeros(shape, dm.cache_dtype),
+            "v": jnp.zeros(shape, dm.cache_dtype),
+        }
+        for _ in range(dm.layers)
+    ]
+
+
+class BlockPool:
+    """Host-side block accounting for one engine (engine-thread only).
+
+    LIFO free list (hot blocks reuse hot HBM lines), per-slot owned
+    lists, and the host-authoritative block table mirrored to device on
+    mutation. All methods raise on invariant violations rather than
+    corrupting silently — a double-free here would hand one physical
+    block to two live slots, the paged equivalent of a use-after-free.
+    """
+
+    def __init__(
+        self,
+        num_slots: int,
+        max_len: int,
+        block_size: int,
+        num_blocks: int = 0,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        if max_len % block_size != 0:
+            raise ValueError(
+                f"block_size {block_size} must divide max_len {max_len} "
+                "(keeps the gathered view bit-identical to the per-slot "
+                "cache layout and prompt buckets block-aligned)"
+            )
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.blocks_per_slot = max_len // block_size
+        auto = num_slots * self.blocks_per_slot + 1
+        self.num_blocks = num_blocks or auto
+        if self.num_blocks < self.blocks_per_slot + 1:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} cannot hold even one "
+                f"max-length stream ({self.blocks_per_slot} blocks "
+                "+ the trash block); the engine could never admit"
+            )
+        # LIFO stack of free physical ids; block 0 (trash) never enters
+        self._free: list[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owned: dict[int, list[int]] = {}
+        self._table = np.zeros((num_slots, self.blocks_per_slot), np.int32)
+        self._dev_table = None  # invalidated on mutation, rebuilt lazily
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # trash excluded
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return sum(len(b) for b in self._owned.values())
+
+    def owned(self, slot: int) -> list[int]:
+        return list(self._owned.get(slot, ()))
+
+    def can_admit(self, n_blocks: int) -> bool:
+        return len(self._free) >= n_blocks
+
+    # -- mutation -----------------------------------------------------------
+
+    def alloc(self, slot: int, n_blocks: int) -> list[int]:
+        """Give ``slot`` its first ``n_blocks`` blocks (admission)."""
+        if slot in self._owned:
+            raise RuntimeError(
+                f"slot {slot} already owns blocks (double-alloc); "
+                "release before re-admitting"
+            )
+        if n_blocks > self.blocks_per_slot:
+            raise ValueError(
+                f"slot {slot} asked for {n_blocks} blocks "
+                f"> blocks_per_slot {self.blocks_per_slot}"
+            )
+        if len(self._free) < n_blocks:
+            raise NoFreeBlocks(
+                f"need {n_blocks} blocks, {len(self._free)} free"
+            )
+        self._owned[slot] = []
+        return self.extend(slot, n_blocks)
+
+    def extend(self, slot: int, n_blocks: int = 1) -> list[int]:
+        """Grow ``slot`` by ``n_blocks`` (decode crossing a boundary)."""
+        owned = self._owned.get(slot)
+        if owned is None:
+            raise RuntimeError(f"slot {slot} owns nothing; alloc first")
+        if len(owned) + n_blocks > self.blocks_per_slot:
+            raise ValueError(
+                f"slot {slot} would exceed blocks_per_slot "
+                f"({len(owned)} + {n_blocks} > {self.blocks_per_slot})"
+            )
+        if len(self._free) < n_blocks:
+            raise NoFreeBlocks(
+                f"need {n_blocks} blocks, {len(self._free)} free"
+            )
+        got = []
+        for _ in range(n_blocks):
+            b = self._free.pop()
+            self._table[slot, len(owned)] = b
+            owned.append(b)
+            got.append(b)
+        self._dev_table = None
+        return got
+
+    def release(self, slot: int) -> list[int]:
+        """Return all of ``slot``'s blocks to the free list and reset its
+        table row to the trash block."""
+        owned = self._owned.pop(slot, None)
+        if owned is None:
+            raise RuntimeError(f"slot {slot} owns nothing (double-free)")
+        for b in owned:
+            if b == TRASH_BLOCK or b in self._free:
+                raise RuntimeError(f"corrupt free list: block {b}")
+            self._free.append(b)
+        self._table[slot, :] = TRASH_BLOCK
+        self._dev_table = None
+        return owned
+
+    # -- views --------------------------------------------------------------
+
+    def block_row(self, slot: int, width: int) -> np.ndarray:
+        """``slot``'s physical ids padded with trash to ``width`` entries
+        (the prefill scatter's fixed-shape index vector: pad blocks
+        beyond the owned prefix land in trash)."""
+        owned = self._owned.get(slot, ())
+        row = np.full((width,), TRASH_BLOCK, np.int32)
+        n = min(len(owned), width)
+        row[:n] = owned[:n]
+        return row
+
+    def device_table(self):
+        """The block table as a device array (cached; host→device copy
+        only after a mutation, never inside the decode step)."""
+        if self._dev_table is None:
+            import jax.numpy as jnp
+
+            self._dev_table = jnp.asarray(self._table)
+        return self._dev_table
+
+    def check(self) -> None:
+        """Invariant sweep (tests + debug): free ∪ owned partitions the
+        non-trash physical blocks with no overlap and no leak."""
+        seen = list(self._free)
+        for slot, blocks in self._owned.items():
+            seen.extend(blocks)
+            row = self._table[slot, : len(blocks)]
+            if list(row) != blocks:
+                raise AssertionError(
+                    f"slot {slot} table row {list(row)} != owned {blocks}"
+                )
+        if sorted(seen) != list(range(1, self.num_blocks)):
+            raise AssertionError(
+                f"block leak/duplicate: {len(seen)} accounted of "
+                f"{self.num_blocks - 1} usable"
+            )
+        if TRASH_BLOCK in seen:
+            raise AssertionError("trash block was allocated")

@@ -1,0 +1,188 @@
+"""Drain-free hot checkpoint swap: generation-tagged artifact reload.
+
+The trainer re-exports the consensus mean at every ``--checkpoint-every``
+boundary (``serve/export.py``, atomic meta-last writes, monotonically
+increasing ``generation``). The serving side closes the loop WITHOUT a
+drain: a watcher thread polls the artifact directory, stages any new
+generation off the engine thread (orbax restore + ``device_put`` happen
+here, never between decode steps), and the engine flips its params
+pointer — and every resident slot's generation tag — between two decode
+steps. No stream drops, no request drains, and because the new mean tree
+has byte-identical leaf shapes/dtypes, the staged params hit the SAME
+compiled executables: zero recompiles across a swap (the e2e test pins
+both).
+
+Streams that straddle a swap keep their KV cache (prefix computed under
+generation g, suffix under g+1). For consensus checkpoints of one
+converging run the trees are deliberately close — this is the standard
+serving trade for continuous deployment, and the per-slot tags +
+``consensusml_serve_generation`` make the boundary observable instead of
+silent.
+
+Monotonicity is enforced on the READ side too: a meta whose generation
+goes backwards (a stale artifact rsynced over a newer one, a clock-reset
+re-export) is rejected and counted on
+``consensusml_serve_swap_rejected_total``, never served.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+__all__ = ["StagedSwap", "GenerationWatcher"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedSwap:
+    """A generation staged on device, ready for an atomic pointer flip."""
+
+    generation: int
+    params: Any
+    meta: dict[str, Any]
+    # meta-file mtime at stage time: the flip-rejection marker uses it
+    # to tell "same bad artifact still on disk" from "corrected artifact
+    # rewritten at the same generation"
+    meta_mtime: float = 0.0
+
+
+class GenerationWatcher:
+    """Polls a serving-artifact dir and stages new generations.
+
+    ``take()`` (engine thread, between decode steps) returns the newest
+    staged swap and clears it — if two generations land within one poll
+    window the engine flips straight to the newest. The loader runs on
+    the watcher thread; a torn/corrupt artifact read (export in flight)
+    is retried next poll, never propagated into the serving loop.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        current_generation: int = 0,
+        poll_s: float = 0.25,
+        loader: Callable[[str], tuple[dict, Any, Any]] | None = None,
+    ):
+        from consensusml_tpu.obs import get_registry
+
+        self.path = path
+        self.poll_s = poll_s
+        self.generation = current_generation  # newest ACCEPTED generation
+        self._loader = loader
+        self._staged: StagedSwap | None = None
+        self._rejected_gen: int | None = None  # last regression counted
+        # (generation, meta_mtime) the ENGINE rejected at flip time —
+        # poll_once skips that exact artifact instead of restaging it
+        # every poll, but a rewrite (new mtime) retries
+        self._flip_rejected: tuple[int, float] | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        reg = get_registry()
+        self._m_staged = reg.counter(
+            "consensusml_serve_swap_staged_total",
+            "new artifact generations loaded and staged by the watcher",
+        )
+        self._m_rejected = reg.counter(
+            "consensusml_serve_swap_rejected_total",
+            "artifact metas rejected (generation not strictly increasing, "
+            "or params tree mismatch at flip time)",
+        )
+        self._m_load = reg.histogram(
+            "consensusml_serve_swap_stage_seconds",
+            "artifact restore + device staging wall time (watcher thread)",
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="serve-hotswap", daemon=True
+        )
+        self._thread.start()
+
+    # -- watcher thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # a half-written artifact or transient IO error is not a
+                # serving incident; the next poll sees the finished write
+                continue
+
+    def poll_once(self) -> bool:
+        """One poll: stage the artifact iff its generation advanced.
+        Public for deterministic tests; returns True when staged."""
+        from consensusml_tpu.serve.export import META_NAME, serving_meta
+
+        try:
+            meta = serving_meta(self.path)
+        except ValueError:
+            return False  # no artifact yet / torn write in progress
+        gen = int(meta.get("generation", 0))
+        if gen <= self.generation:
+            # count each observed regression ONCE, not once per poll — a
+            # stale artifact sits on disk until replaced, and a counter
+            # ramping 4/s would read as a flood of bad exports
+            if gen < self.generation and gen != self._rejected_gen:
+                self._rejected_gen = gen
+                self._m_rejected.inc()
+            return False
+        import os
+
+        try:
+            mtime = os.path.getmtime(os.path.join(self.path, META_NAME))
+        except OSError:
+            return False  # replaced between read and stat; next poll
+        if self._flip_rejected == (gen, mtime):
+            return False  # engine rejected THIS artifact; await a rewrite
+        import jax
+
+        t0 = time.perf_counter()
+        if self._loader is not None:
+            _meta, params, _ms = self._loader(self.path)
+        else:
+            from consensusml_tpu.serve.export import load_serving
+
+            _meta, params, _ms = load_serving(self.path)
+        params = jax.device_put(params)
+        # force the H2D copies HERE, not lazily at the engine's first
+        # post-flip step (that would be a hidden prefill-sized stall)
+        jax.block_until_ready(params)
+        self._m_load.observe(time.perf_counter() - t0)
+        with self._lock:
+            self._staged = StagedSwap(gen, params, meta, mtime)
+            self.generation = gen
+        self._m_staged.inc()
+        return True
+
+    # -- engine thread ------------------------------------------------------
+
+    def take(self) -> StagedSwap | None:
+        if self._staged is None:  # benign race: worst case, next step
+            return None
+        with self._lock:
+            staged, self._staged = self._staged, None
+        return staged
+
+    def reject(self, staged: StagedSwap | None = None) -> None:
+        """Engine-side rejection (tree mismatch at flip time).
+
+        Rolls the accepted-generation mark BACK so a corrected artifact
+        re-exported at the SAME generation number is staged on a later
+        poll — without the rollback, one bad artifact would poison its
+        generation forever and the engine would silently serve stale
+        params until some writer bumped past it. The (generation, meta
+        mtime) marker keeps the watcher from restaging the exact bad
+        artifact every poll window."""
+        self._m_rejected.inc()
+        if staged is None:
+            return
+        with self._lock:
+            self._flip_rejected = (staged.generation, staged.meta_mtime)
+            if self.generation == staged.generation:
+                self.generation = staged.generation - 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,154 @@
+"""Disaggregated prefill/decode stages over the paged block pool.
+
+The PR 5 engine ran ONE fused step family per admission pattern: when
+several requests arrived together, `_admit_waiting` prefilled every free
+slot back-to-back before the next decode step, so one long prompt — or a
+burst of them — stalled every in-flight stream (inter-token latency
+spikes exactly when traffic peaks). This module splits the two phases
+into separately-jitted, separately-scheduled stages:
+
+- :func:`make_paged_prefill_fn` — one executable per prompt bucket, full
+  causal forward, K/V scattered into the slot's OWNED pool blocks (pad
+  blocks beyond the owned prefix land in the trash block);
+- :func:`make_paged_decode_fn` — ONE executable for all slots at every
+  occupancy/length mix, block indices computed inside the jit from the
+  block table (no host sync, no recompile — contract-pinned per stage by
+  ``analysis/jaxpr_contracts.py``);
+- :class:`AdmissionScheduler` — the host-side policy between them: every
+  engine tick runs AT MOST ``prefill_budget`` tokens of prefill, and the
+  decode step runs every tick regardless, so decode never waits behind
+  more than one budget's worth of prefill. (On one host the stages share
+  a device; a multi-replica deployment would place them on disjoint
+  replicas — the program split here is the prerequisite either way.)
+
+TTFT p99 (``consensusml_serve_ttft_seconds``) is the target metric; the
+bench serving section compares the fused baseline against the staged
+path at an equal token budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+__all__ = [
+    "make_paged_prefill_fn",
+    "make_paged_decode_fn",
+    "AdmissionScheduler",
+]
+
+
+def make_paged_prefill_fn(dm: Any) -> Callable:
+    """``prefill(params, pages, ids (1, L), length, block_row (L//bs,))``
+    -> ``(first_token, last_logits (V,), new_pages)``.
+
+    One executable per padded bucket length ``L`` (block-aligned by
+    construction: the engine's paged buckets start at the block size).
+    The forward is the SAME ``return_kv`` trace the per-slot prefill
+    uses; only the cache insertion differs — each ``block_size`` chunk of
+    the prompt's K/V scatters to the physical block its table row names.
+    ``block_row`` entries past the owned prefix are the trash block, so
+    pad chunks never touch pages another slot owns; duplicate trash
+    indices are benign (last-write-wins over garbage).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve.decode import _donate_cache
+
+    model = dm.model
+
+    def prefill(params, pages, ids, length, block_row):
+        logits, kvs = model.apply(
+            {"params": params}, ids, deterministic=True, return_kv=True
+        )
+        last = logits[0, length - 1]  # (V,) — last REAL token's logits
+        bs = pages[0]["k"].shape[1]
+        nblk = ids.shape[1] // bs
+        new_pages = []
+        for pg, (k, v) in zip(pages, kvs):
+            # (1, L, H, D) -> (nblk, bs, H, D): chunk per physical block
+            kr = jnp.asarray(k[0], pg["k"].dtype).reshape(
+                nblk, bs, *k.shape[2:]
+            )
+            vr = jnp.asarray(v[0], pg["v"].dtype).reshape(
+                nblk, bs, *v.shape[2:]
+            )
+            new_pages.append(
+                {
+                    "k": pg["k"].at[block_row].set(kr),
+                    "v": pg["v"].at[block_row].set(vr),
+                }
+            )
+        return jnp.argmax(last).astype(jnp.int32), last, new_pages
+
+    return jax.jit(prefill, donate_argnums=_donate_cache())
+
+
+def make_paged_decode_fn(dm: Any) -> Callable:
+    """``decode(params, pages, block_table (S, nb), tokens (S,),
+    positions (S,))`` -> ``(next_tokens (S,), new_pages)``.
+
+    One token for ALL slots; each lane's write/read indices derive from
+    its block-table row inside the jit
+    (:func:`consensusml_tpu.models.attention.paged_update_kv_cache`).
+    Occupancy, lengths, and block assignments are all DATA — one
+    executable serves every mix, the zero-recompile contract. Only the
+    pages donate; the block table is reused across steps.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve.decode import _donate_cache
+
+    model = dm.model
+
+    def decode(params, pages, block_table, tokens, positions):
+        logits, new_pages = model.apply(
+            {"params": params},
+            tokens[:, None],
+            deterministic=True,
+            positions=positions,
+            kv_cache=pages,
+            block_table=block_table,
+        )
+        return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), new_pages
+
+    return jax.jit(decode, donate_argnums=_donate_cache())
+
+
+class AdmissionScheduler:
+    """Per-tick prefill admission budget (host ints only, no device).
+
+    One engine tick = one decode step + whatever prefills fit the token
+    budget. ``try_admit`` charges a candidate's BUCKET length (what the
+    device actually computes) against the tick's remaining budget:
+
+    - the first admission of a tick always fits (otherwise a prompt
+      longer than the budget would starve forever);
+    - later admissions must fit the remaining budget, so a burst of
+      arrivals spreads over several ticks instead of stalling decode for
+      the whole burst — bounded added TTFT for the tail of the burst,
+      bounded inter-token latency for everyone already decoding.
+    """
+
+    def __init__(self, prefill_budget: int):
+        if prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be positive, got {prefill_budget}"
+            )
+        self.prefill_budget = prefill_budget
+        self._remaining = prefill_budget
+        self._admitted_this_tick = 0
+
+    def start_tick(self) -> None:
+        self._remaining = self.prefill_budget
+        self._admitted_this_tick = 0
+
+    def try_admit(self, bucket_tokens: int) -> bool:
+        """Charge one prefill of ``bucket_tokens`` against this tick;
+        False = defer the request to the next tick."""
+        if self._admitted_this_tick and bucket_tokens > self._remaining:
+            return False
+        self._remaining = max(0, self._remaining - bucket_tokens)
+        self._admitted_this_tick += 1
+        return True

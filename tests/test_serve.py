@@ -382,6 +382,7 @@ def test_e2e_train_export_serve_demo(tmp_path):
     meta = serving_meta(art)
     assert meta == {
         "config_name": "gpt2_topk", "scale": "smoke", "round": 2, "world_size": 2,
+        "generation": 1,  # first export at this path (hot-swap ordering key)
     }
 
     # jaxpr-asserted zero recompiles: the decode contract (step r's output

@@ -18,9 +18,12 @@ Passes:
   --jaxpr       traced train-step contracts per config: no host
                 callbacks, no f64, collective count == verified
                 schedule, no round-to-round recompile; causal-LM
-                configs additionally get the SERVING decode-step
-                contracts (no host sync per token, step-over-step
-                canonical-jaxpr stability = zero decode recompiles)
+                configs additionally get the SERVING contracts — the
+                per-slot decode step AND both paged stages
+                (serve/pool/ prefill + decode) independently: no host
+                callback in the block-index computation, no f64,
+                step-over-step canonical-jaxpr stability per stage =
+                zero serving recompiles
   --locks       lock-discipline race lint over @guarded_by classes
 
 Exit codes: 0 clean (or everything suppressed), 1 active findings,

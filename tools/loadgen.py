@@ -6,10 +6,14 @@ Drives an exported consensus artifact (in-process engine) or a running
 open-loop traffic: arrivals follow a Poisson process at ``--rate`` req/s
 REGARDLESS of completions — the honest way to measure serving SLOs
 (closed-loop generators self-throttle and hide queueing collapse).
-Prompt lengths draw uniformly from ``--prompt-len LO:HI`` so admissions
-exercise every prefill bucket. Reports client-observed TTFT / end-to-end
-latency percentiles, goodput, and (in-process mode) the engine's own
-SLO stats, as one ``LOADGEN`` JSON line.
+Prompt lengths draw from ``--prompt-len LO:HI`` — uniformly by default
+(every prefill bucket gets hit) or with ``--len-dist zipf`` as the
+heavy-tail production mix the paged KV pool is sized for. With
+``--swap-every N`` every N-th arrival first bumps the artifact's
+generation so the engine's hot-swap watcher reloads MID-TRAFFIC (tail
+latency under drain-free rollout). Reports client-observed TTFT /
+end-to-end latency percentiles, goodput, and (in-process mode) the
+engine's own SLO stats, as one ``LOADGEN`` JSON line.
 
 ``--obs-snapshot DIR`` additionally writes the client-observed SLOs as
 a ``consensusml_loadgen_*`` metrics snapshot (``obs-loadgen-<seed>.json``,
@@ -40,6 +44,21 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def sample_prompt_len(rng, lo: int, hi: int, dist: str = "uniform") -> int:
+    """One prompt length in ``[lo, hi]``.
+
+    ``uniform`` exercises every prefill bucket evenly; ``zipf`` is the
+    heavy-tail production mix (most prompts short, a fat tail of long
+    ones — Zipf(a=1.5) offsets clipped into the range), the distribution
+    under which per-slot max-length caches waste the most HBM and the
+    paged pool's occupancy advantage shows (bench serving section)."""
+    if dist == "uniform":
+        return int(rng.integers(lo, hi + 1))
+    if dist == "zipf":
+        return min(lo + int(rng.zipf(1.5)) - 1, hi)
+    raise ValueError(f"unknown length distribution {dist!r}")
+
+
 def run_loadgen(
     submit,
     *,
@@ -49,17 +68,25 @@ def run_loadgen(
     vocab: int,
     max_new_tokens: int,
     seed: int = 0,
+    len_dist: str = "uniform",
+    swap_every: int = 0,
+    swap_fn=None,
 ) -> dict:
     """Open-loop driver over any ``submit(ids, max_new) -> result_dict``
     callable (``result_dict``: ``ttft_s``, ``latency_s``, ``tokens``).
     Each arrival runs on its own thread so a slow request never delays
-    the next arrival (that is what makes the loop open)."""
+    the next arrival (that is what makes the loop open). With
+    ``swap_every`` + ``swap_fn``, every ``swap_every``-th arrival first
+    triggers ``swap_fn()`` (the hot-swap poke: bump the artifact's
+    generation mid-traffic) — tail latency under live reload is part of
+    the SLO story, not a separate benchmark."""
     rng = np.random.default_rng(seed)
     lo, hi = prompt_lens
     results: list[dict] = []
     errors: list[str] = []
     lock = threading.Lock()
     threads = []
+    swaps = 0
 
     def one(ids):
         try:
@@ -71,8 +98,12 @@ def run_loadgen(
                 errors.append(f"{type(e).__name__}: {e}")
 
     t_start = time.perf_counter()
-    for _ in range(n_requests):
-        ids = rng.integers(0, vocab - 1, size=int(rng.integers(lo, hi + 1)))
+    for i in range(n_requests):
+        if swap_fn is not None and swap_every and i and i % swap_every == 0:
+            swap_fn()
+            swaps += 1
+        n = sample_prompt_len(rng, lo, hi, len_dist)
+        ids = rng.integers(0, vocab - 1, size=n)
         t = threading.Thread(target=one, args=(list(map(int, ids)),))
         threads.append(t)
         t.start()
@@ -92,6 +123,8 @@ def run_loadgen(
         "completed": len(results),
         "errors": len(errors),
         "error_sample": errors[:3],
+        "len_dist": len_dist,
+        "swaps_triggered": swaps,
         "offered_rate_rps": rate_rps,
         "achieved_rps": len(results) / wall if wall > 0 else 0.0,
         "tokens_out": tokens_out,
@@ -111,19 +144,17 @@ def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
     side exports (docs/observability.md)."""
     from consensusml_tpu.obs import get_registry
 
+    from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+
     reg = get_registry()
-    # sub-second SLO work: finer buckets than the round-latency default
-    slo_buckets = (
-        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-        1.0, 2.5, 5.0, 10.0, 30.0,
-    )
     ttft = reg.histogram(
         "consensusml_loadgen_ttft_seconds",
-        "client-observed time to first token", buckets=slo_buckets,
+        "client-observed time to first token", buckets=DEFAULT_SLO_BUCKETS,
     )
     lat = reg.histogram(
         "consensusml_loadgen_latency_seconds",
-        "client-observed end-to-end request latency", buckets=slo_buckets,
+        "client-observed end-to-end request latency",
+        buckets=DEFAULT_SLO_BUCKETS,
     )
     for r in results:
         ttft.observe(r["ttft_s"])
@@ -198,6 +229,17 @@ def main(argv=None) -> int:
     p.add_argument("--requests", type=int, default=100)
     p.add_argument("--max-new", type=int, default=16)
     p.add_argument("--prompt-len", default="4:24", metavar="LO:HI")
+    p.add_argument("--len-dist", default="uniform", choices=("uniform", "zipf"),
+                   help="prompt-length mix: uniform hits every prefill "
+                        "bucket evenly; zipf is the heavy-tail production "
+                        "mix (mostly short prompts, fat tail to HI) that "
+                        "the paged KV pool's occupancy bound is sized for")
+    p.add_argument("--swap-every", type=int, default=0, metavar="N",
+                   help="every N arrivals, bump the artifact's generation "
+                        "(serve/export.bump_generation) so the engine's "
+                        "hot-swap watcher reloads mid-traffic — proves "
+                        "tail latency under drain-free reload (artifact "
+                        "mode only)")
     p.add_argument("--slots", type=int, default=8, help="engine slots (artifact mode)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--obs-snapshot", default=None, metavar="DIR",
@@ -210,6 +252,7 @@ def main(argv=None) -> int:
 
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
     engine = None
+    swap_fn = None
     if args.artifact:
         from consensusml_tpu.serve import ServeConfig, load_engine
 
@@ -220,7 +263,16 @@ def main(argv=None) -> int:
         engine.warmup()
         vocab = engine._dm.vocab_size
         submit = _engine_submit(engine)
+        if args.swap_every:
+            from consensusml_tpu.serve.export import bump_generation
+
+            engine.watch(args.artifact, poll_s=0.05)
+            swap_fn = lambda: bump_generation(args.artifact)
     else:
+        if args.swap_every:
+            print("error: --swap-every needs --artifact (the generation "
+                  "bump touches the artifact dir)", file=sys.stderr)
+            return 2
         host, _, port = args.connect.partition(":")
         vocab = 64  # socket mode cannot introspect the model; ids stay tiny
         submit = _socket_submit(host, int(port))
@@ -233,6 +285,9 @@ def main(argv=None) -> int:
         vocab=vocab,
         max_new_tokens=args.max_new,
         seed=args.seed,
+        len_dist=args.len_dist,
+        swap_every=args.swap_every,
+        swap_fn=swap_fn,
     )
     if engine is not None:
         report["engine"] = engine.stats()

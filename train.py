@@ -211,7 +211,11 @@ def parse_args(argv=None):
                         "the shared consensus mean into a deployable "
                         "params tree + serve_meta.json that "
                         "serve.load_engine() / tools/loadgen.py start "
-                        "from directly (docs/serving.md)")
+                        "from directly. Each export bumps the artifact's "
+                        "generation counter, so an engine watching DIR "
+                        "(Engine.watch) hot-swaps to every new mean "
+                        "mid-traffic — no drain, no dropped streams "
+                        "(docs/serving.md)")
     p.add_argument("--resume", default=None, help="checkpoint path to resume from")
     p.add_argument("--list", action="store_true", help="list configs and exit")
     return p.parse_args(argv)
@@ -1202,14 +1206,18 @@ def _train_loop(
         # 1/W of the checkpoint — and the train->serve handoff must be
         # complete when the log line lands
         nonlocal last_exported
-        from consensusml_tpu.serve.export import export_serving
+        from consensusml_tpu.serve.export import export_serving, serving_meta
 
         path = export_serving(
             args.export_serving, state,
             config_name=bundle.name, scale=scale, round=rnd,
         )
         last_exported = rnd
-        print(f"serving artifact: {path} (round {rnd})", flush=True)
+        gen = serving_meta(path).get("generation", "?")
+        print(
+            f"serving artifact: {path} (round {rnd}, generation {gen})",
+            flush=True,
+        )
 
     batch_source = bundle.batches
     if args.native_loader:
