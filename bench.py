@@ -747,6 +747,141 @@ def _serving_bench() -> dict:
     return out
 
 
+def _fused_wire_compare(params, topo, gamma: float, steps: int) -> dict:
+    """FUSED one-pass wire vs the two-step bucketed path, same codec,
+    same bucket plan, SAME BYTES (ISSUE 9 acceptance): per gossip round,
+    the two-step chain runs delta -> quantize -> dequantize -> xhat
+    update -> per-neighbor dequantize-accumulate as separate programs
+    that each round-trip HBM over every bucket; the fused wire runs ONE
+    pack+quantize kernel and ONE dequantize+accumulate kernel per bucket
+    (docs/gossip_bucketing.md "Fused wire"). Neighbor payloads reuse the
+    local payload exactly as the surrounding gossip bench does — the
+    per-worker COMPUTE is what this costs, and it is identical to the
+    engine's fused/unfused innovation exchanges. Codec impl resolves
+    "auto": compiled Pallas kernels on TPU (where the HBM-touch
+    accounting is the measurement), jnp reference elsewhere (CPU smoke:
+    both paths are XLA-fused elementwise chains, so the ratio there is a
+    floor, not the TPU number)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.compress import PallasInt8Compressor
+    from consensusml_tpu.compress.kernels import _resolve_impl
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+    from consensusml_tpu.consensus.bucketing import build_fused_plan
+
+    comp = PallasInt8Compressor(chunk=512, impl="auto")
+    engine = ConsensusEngine(
+        GossipConfig(topology=topo, compressor=comp, gamma=gamma)
+    )
+    leaves, treedef = jax.tree.flatten(params)
+    plan = engine.bucket_plan(params)
+    fused = build_fused_plan(plan, comp)
+    assert fused is not None and engine.fused_wire_active
+    weights = (topo.self_weight,) + tuple(sh.weight for sh in topo.shifts)
+
+    # equal-bytes check: the fused payloads must be byte-identical in
+    # layout to the two-step codec's (a transport fusion, not a codec
+    # change) — computed from abstract payloads, nothing materialized
+    def _payload_bytes(payloads) -> int:
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(payloads)
+        )
+
+    zeros = [jnp.zeros((b.total,), jnp.float32) for b in plan.buckets]
+    fused_bytes = _payload_bytes(
+        jax.eval_shape(lambda bufs: fused.encode(bufs, bufs)[0], zeros)
+    )
+    two_step_bytes = sum(
+        comp.wire_bytes((b.total,), jnp.float32) for b in plan.buckets
+    )
+
+    def wire_round(mode):
+        def body(carry, _):
+            x, xhat, s = carry
+            bufs = plan.pack(jax.tree.leaves(x))
+            if mode == "fused":
+                q, xhat = fused.encode(bufs, xhat)
+                sources = [[qb] * len(weights) for qb in q]
+                s = fused.decode_accumulate(s, sources, weights)
+            else:
+                # the two-step chain, bucket by bucket — exactly the
+                # engine's unfused _innovation_exchange_collective with
+                # the local payload standing in for each neighbor's
+                delta = [b - h for b, h in zip(bufs, xhat)]
+                q = [comp.compress(d) for d in delta]
+                dec = [comp.decompress(p) for p in q]
+                xhat = [h + d for h, d in zip(xhat, dec)]
+                recv = [topo.self_weight * d for d in dec]
+                for sh in topo.shifts:
+                    recv = [
+                        comp.decompress_accumulate(p, r, sh.weight)
+                        for p, r in zip(q, recv)
+                    ]
+                s = [si + r for si, r in zip(s, recv)]
+            newb = [
+                b + gamma * (si - hi) for b, si, hi in zip(bufs, s, xhat)
+            ]
+            x = jax.tree.unflatten(treedef, plan.unpack(newb))
+            return (x, xhat, s), jnp.float32(0)
+
+        return body
+
+    def run(mode: str) -> float:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def multi(carry):
+            return jax.lax.scan(wire_round(mode), carry, None, length=steps)
+
+        x0 = jax.tree.map(
+            lambda v: jnp.array(v, jnp.float32, copy=True), params
+        )
+        z = [jnp.zeros((b.total,), jnp.float32) for b in plan.buckets]
+        carry = (x0, z, [jnp.copy(b) for b in z])
+        carry, _ = multi(carry)
+        float(jax.tree.leaves(carry[0])[0].reshape(-1)[0])  # fence
+        t0 = time.time()
+        carry, _ = multi(carry)
+        float(jax.tree.leaves(carry[0])[0].reshape(-1)[0])  # fence
+        return 1000 * (time.time() - t0) / steps
+
+    unfused_ms = run("two_step")
+    fused_ms = run("fused")
+    n_params = sum(x.size for x in leaves)
+    per_neighbor = fused_bytes
+    impl = _resolve_impl("auto")
+    note = (
+        "kernel path: one pallas encode + one decode per bucket vs the "
+        "4-program two-step chain — the HBM-touch cut under measurement"
+        if impl == "pallas"
+        else "cpu smoke floor: impl resolves to jnp off-TPU, so BOTH "
+        "paths are XLA-fused elementwise chains and the ratio does not "
+        "measure the kernel path's HBM-touch cut — the acceptance "
+        "number is the TPU (impl=pallas) row at gpt2-medium scale"
+    )
+    return {
+        "codec": f"int8/{fused.codec.chunk}",
+        "impl": impl,
+        "note": note,
+        "buckets": plan.num_buckets,
+        "unfused_round_ms": round(unfused_ms, 2),
+        "fused_round_ms": round(fused_ms, 2),
+        "speedup_x": round(unfused_ms / max(fused_ms, 1e-9), 2),
+        "wire_bytes_per_neighbor": per_neighbor,
+        "bytes_equal_two_step": fused_bytes == two_step_bytes,
+        "compression_x": round(n_params * 4 / per_neighbor, 1),
+        "kernel_calls_per_round": 2 * plan.num_buckets,
+        "two_step_hbm_touches_per_round": (
+            # delta write+read, q write+read, dec write+read, xhat rmw,
+            # per-neighbor dequant+axpy — the accounting the fused wire
+            # collapses to one read + one write per stage
+            (4 + 2 * len(topo.shifts)) * plan.num_buckets
+        ),
+    }
+
+
 def _gossip_round_bench() -> dict:
     """Cost of ONE full-model CHOCO compressed-gossip round at the
     config-5 scale: compress + decompress + xhat/s innovation update over
@@ -863,6 +998,8 @@ def _gossip_round_bench() -> dict:
         "gossip_round_ms": round(bucketed_ms, 2),  # bucketed: the default
         "per_leaf_round_ms": round(per_leaf_ms, 2),
     }
+    out["fused_wire"] = _fused_wire_compare(params, topo, gamma, steps)
+    out["fused_wire_speedup_x"] = out["fused_wire"]["speedup_x"]
     # the rejected fused-tree variant costs a second full compile each
     # run; measure it only on request (the 85 vs 134 ms comparison is
     # recorded in docs/perf.md)
@@ -1183,9 +1320,11 @@ def _consensus_bench() -> dict:
 def _consensus32_bench() -> dict:
     """The headline metric's ADVERTISED worker count: 32-worker gossip
     (BASELINE.json "consensus-error (ResNet-50, 32-worker gossip)"),
-    ring and 4x8 torus, on the simulated backend — one device hosts all
-    32 replicas, so this runs anywhere (VERDICT r3 item 3: every prior
-    recorded trajectory stopped at 8 workers). The decay constant under
+    across the topology families — ring, 4x8 torus, dense — with a
+    rounds-to-eps table per family (ROADMAP item 3's seed data), on the
+    simulated backend — one device hosts all 32 replicas, so this runs
+    anywhere (VERDICT r3 item 3: every prior recorded trajectory
+    stopped at 8 workers). The decay constant under
     test is a property of the TOPOLOGY's mixing matrix, not the model —
     a 32-wide ResNet blew the section's budget on CPU compile alone, so
     the model here is the MLP (the ResNet-class row lives in the
@@ -1212,8 +1351,23 @@ def _consensus32_bench() -> dict:
     world, rounds, batch = 32, 12, 8
     model = MLP(hidden=64)
     data = SyntheticClassification(n=512, image_shape=(28, 28, 1))
-    out: dict = {"world": world, "model": "mlp (topology decay probe)", "rounds": rounds}
-    for name in ("ring", "torus"):
+    out: dict = {
+        "world": world,
+        "model": "mlp (topology decay probe)",
+        "rounds": rounds,
+        # rounds-to-eps semantics: rounds for the consensus error to fall
+        # below eps x (first-round error) — measured from the trajectory
+        # when it gets there within the probe, extrapolated from the
+        # measured per-round decay otherwise ("~N"). The cross-family
+        # table is the measurable seed for the topology auto-tuner
+        # (ROADMAP item 3): it prices a topology in ROUNDS, the unit the
+        # per-link latency probes convert to wall time.
+        "rounds_to_eps_note": (
+            "rounds until consensus error <= eps * first-round error; "
+            "'~' marks extrapolation from the measured per-round decay"
+        ),
+    }
+    for name in ("ring", "torus", "dense"):
         topo = topology_from_name(name, world)
         cfg = LocalSGDConfig(
             gossip=GossipConfig(topology=topo),
@@ -1227,16 +1381,35 @@ def _consensus32_bench() -> dict:
         for b in round_batches(data, world, cfg.h, batch, rounds):
             state, metrics = step(state, b)
             errs.append(float(metrics["consensus_error"]))
+        decay = (errs[-1] / errs[0]) ** (1 / (rounds - 1)) if errs[0] else 0.0
         out[name] = {
             "mesh": list(topo.mesh_shape),
             "consensus_error_first": round(errs[0], 4),
             "consensus_error_last": round(errs[-1], 4),
-            "per_round_decay": round(
-                (errs[-1] / errs[0]) ** (1 / (rounds - 1)), 4
-            ),
+            "per_round_decay": round(decay, 4),
             "spectral_bound": round(1 - topo.spectral_gap(), 4),
+            "rounds_to_eps": {
+                str(eps): _rounds_to_eps(errs, decay, eps)
+                for eps in (0.5, 0.1, 0.01)
+            },
         }
     return out
+
+
+def _rounds_to_eps(errs: list, decay: float, eps: float):
+    """Rounds until the consensus error reaches ``eps`` of its
+    first-round value: the measured crossing when the trajectory gets
+    there, else a decay-rate extrapolation tagged ``"~N"`` (and ``None``
+    when the error is not contracting at all)."""
+    import math
+
+    target = eps * errs[0]
+    for i, e in enumerate(errs):
+        if e <= target:
+            return i  # rounds AFTER the first measurement
+    if not 0.0 < decay < 1.0:
+        return None
+    return f"~{math.ceil(math.log(eps) / math.log(decay))}"
 
 
 def _consensus32_resnet_bench() -> dict:

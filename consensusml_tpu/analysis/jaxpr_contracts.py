@@ -43,7 +43,12 @@ from typing import Any
 
 from consensusml_tpu.analysis.findings import Finding
 
-__all__ = ["check_config", "check_all_configs", "count_primitives"]
+__all__ = [
+    "check_config",
+    "check_all_configs",
+    "check_fused_wire",
+    "count_primitives",
+]
 
 PASS = "jaxpr"
 
@@ -297,6 +302,158 @@ def _check_collective_count(name: str, bundle) -> list[Finding]:
     return findings
 
 
+def _shard_map_no_check(fn, *, mesh, in_specs, out_specs):
+    """``shard_map`` with the per-output replication check disabled:
+    ``pallas_call`` has no replication rule (jax 0.4.x ``check_rep`` /
+    newer ``check_vma``), and for a TRACE-ONLY contract the check adds
+    nothing — the schedule verifier already proves the collective
+    structure this pass counts."""
+    sm = _shard_map_fn()
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return sm(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+            )
+        except TypeError:  # this jax spells the kwarg differently
+            continue
+    raise RuntimeError("unreachable: bare shard_map always constructs")
+
+
+def check_fused_wire(world: int = 8) -> list[Finding]:
+    """Contracts of the FUSED one-pass gossip wire (ROADMAP item 5 /
+    docs/gossip_bucketing.md "Fused wire"): trace ``round_collective``
+    for a representative fused engine per topology class and assert, on
+    the traced program itself:
+
+    - ``fused-active`` — the engine engages the fused wire at all
+      (bucketed transport + a codec advertising fused kernels under
+      ``fused_wire="auto"``); a silent fallback to the two-step path
+      would pass every other contract while fusing nothing;
+    - ``kernel-count`` — exactly ONE ``pallas_call`` per bucket per
+      kernel stage per innovation exchange: encode + decode per bucket
+      on ppermute topologies, encode only on psum topologies (the dense
+      receive decodes in plain ops under the reduction). More means a
+      stage un-fused (extra HBM round-trips — the regression this wire
+      exists to prevent); fewer means a bucket fell off the kernel path;
+    - ``collective-count`` — the fused program's traced ppermute count
+      still equals the schedule verifier's model (fusion changes HBM
+      traffic, never the wire: same payload leaves, same collectives);
+    - the shared purity contracts (no host callbacks, no f64).
+
+    Traced with the codec's ``interpret`` impl so the kernels appear as
+    ``pallas_call`` equations on any host — the compiled TPU program has
+    the same jaxpr modulo lowering.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from consensusml_tpu.analysis import schedule as sched
+    from consensusml_tpu.comm import WorkerMesh
+    from consensusml_tpu.compress import PallasInt8Compressor
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+    from consensusml_tpu.topology import DenseTopology, RingTopology
+
+    findings: list[Finding] = []
+    if len(jax.devices()) < world:
+        return [
+            Finding(
+                PASS, "kernel-count", "fused-wire", "gossip_round",
+                "no-mesh",
+                f"cannot trace the fused wire: {world} workers but only "
+                f"{len(jax.devices())} devices "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count)",
+            )
+        ]
+    comp = PallasInt8Compressor(chunk=128, impl="interpret")
+    # two f32 leaves sized to split into multiple buckets at a small cap,
+    # exercising the per-bucket (not per-round) kernel accounting
+    tree = {
+        "w": jax.ShapeDtypeStruct((4096, 16), jax.numpy.float32),
+        "b": jax.ShapeDtypeStruct((513,), jax.numpy.float32),
+    }
+    for topo in (RingTopology(world), DenseTopology(world)):
+        tag = type(topo).__name__.removesuffix("Topology").lower()
+        mk = lambda rule, detail, msg, tag=tag: Finding(
+            PASS, rule, f"fused-wire:{tag}", "gossip_round", detail, msg
+        )
+        engine = ConsensusEngine(
+            GossipConfig(
+                topology=topo, compressor=comp, gamma=0.5,
+                bucket_bytes=64 * 1024,
+            )
+        )
+        if not engine.fused_wire_active:
+            findings.append(
+                mk(
+                    "fused-active", "two-step-fallback",
+                    "a bucketed engine with a fused-capable codec "
+                    "(PallasInt8) does not engage the fused wire under "
+                    "fused_wire='auto' — the one-pass kernels silently "
+                    "fell back to the two-step path",
+                )
+            )
+            continue
+        plan = engine.bucket_plan(tree)
+        stages = 1 if topo.uses_psum else 2  # psum decodes in plain ops
+        expected = stages * plan.num_buckets * engine.config.gossip_steps
+        stacked = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                (world,) + tuple(x.shape), x.dtype
+            ),
+            tree,
+        )
+        wmesh = WorkerMesh.create(topo, platform="cpu")
+
+        def round_fn(t, engine=engine):
+            st = engine.init_state(t)
+            out, _ = engine.round_collective(t, st, step=np.int32(0))
+            return out
+
+        f = _shard_map_no_check(
+            round_fn,
+            mesh=wmesh.mesh,
+            in_specs=P(*topo.axis_names),
+            out_specs=P(*topo.axis_names),
+        )
+        closed = jax.make_jaxpr(f)(stacked)
+        findings += _callback_f64_findings(
+            closed, mk, f"fused {tag} gossip round"
+        )
+        counts = count_primitives(closed)
+        traced_kernels = counts.get("pallas_call", 0)
+        if traced_kernels != expected:
+            findings.append(
+                mk(
+                    "kernel-count", "pallas_call",
+                    f"fused {tag} round traces {traced_kernels} "
+                    f"pallas_call(s) but the one-pass wire contract is "
+                    f"{expected} ({stages} stage(s) x {plan.num_buckets} "
+                    f"buckets x {engine.config.gossip_steps} gossip "
+                    "step(s)) — a stage un-fused (extra HBM round-trips) "
+                    "or a bucket fell off the kernel path",
+                )
+            )
+        traced = counts.get("ppermute", 0)
+        predicted = sum(
+            1
+            for op in sched.materialize_schedules(engine, tree)[0]
+            if op.kind == "ppermute"
+        )
+        if traced != predicted:
+            findings.append(
+                mk(
+                    "collective-count", "ppermute",
+                    f"fused {tag} round traces {traced} ppermutes but the "
+                    f"verified schedule models {predicted} — fusion must "
+                    "change HBM traffic, never the wire (same payload "
+                    "leaves, same collectives); update "
+                    "analysis/schedule.py alongside the fused wire",
+                )
+            )
+    return findings
+
+
 def _check_decode_jaxpr(name: str, bundle) -> list[Finding]:
     """Serving decode-step contracts (causal-LM configs only).
 
@@ -508,4 +665,15 @@ def check_all_configs(*, scale: str = "smoke") -> list[Finding]:
                     f"tracing the {name} train step failed: {e}",
                 )
             )
+    # the fused one-pass wire is config-independent (engages per codec,
+    # not per config); its contracts ride the same pass
+    try:
+        findings.extend(check_fused_wire())
+    except Exception as e:
+        findings.append(
+            Finding(
+                PASS, "trace-error", "fused-wire", "", type(e).__name__,
+                f"tracing the fused gossip wire failed: {e}",
+            )
+        )
     return findings

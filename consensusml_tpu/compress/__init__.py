@@ -21,6 +21,7 @@ static per-tensor k — flagged in SURVEY.md §7 as a risk to re-check.
 from consensusml_tpu.compress.base import (  # noqa: F401
     ComposedCompressor,
     Compressor,
+    Fp8Payload,
     IdentityCompressor,
     Int4Payload,
     Int8Payload,
@@ -29,9 +30,13 @@ from consensusml_tpu.compress.base import (  # noqa: F401
 )
 from consensusml_tpu.compress.kernels import (  # noqa: F401
     ChunkedTopKCompressor,
+    FusedBucketCodec,
+    PallasFp8Compressor,
     PallasInt4Compressor,
     PallasInt8Compressor,
     chunk_scatter,
+    fused_bucket_codec,
+    resolve_codec_impl,
 )
 from consensusml_tpu.compress.extra import (  # noqa: F401
     LowRankPayload,
@@ -43,6 +48,7 @@ from consensusml_tpu.compress.extra import (  # noqa: F401
     SignPayload,
 )
 from consensusml_tpu.compress.reference import (  # noqa: F401
+    Fp8Compressor,
     Int4Compressor,
     Int8Compressor,
     TopKCompressor,

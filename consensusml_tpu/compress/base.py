@@ -21,10 +21,16 @@ __all__ = [
     "LocalTopKPayload",
     "Int8Payload",
     "Int4Payload",
+    "Fp8Payload",
     "IdentityCompressor",
     "ComposedCompressor",
     "static_k",
+    "FP8_E4M3_MAX",
 ]
+
+# float8_e4m3fn's largest finite value — the "levels" constant of the fp8
+# wire codecs, the exact analogue of 127 (int8) and 7 (int4)
+FP8_E4M3_MAX = 448.0
 
 
 def static_k(size: int, ratio: float, k: int | None) -> int:
@@ -129,6 +135,34 @@ class Int4Payload:
         return cls(children[0], children[1], aux[0], aux[1], aux[2])
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Fp8Payload:
+    """Per-chunk scaled float8 (e4m3) quantization.
+
+    ``scale = absmax / 448`` per chunk (448 = e4m3fn's finite max), so the
+    largest-magnitude element of every chunk lands exactly on the format's
+    max and the rest keep e4m3's 3 mantissa bits of RELATIVE precision —
+    the same byte width as int8 at a very different error profile (int8's
+    error is uniform in absolute terms; fp8's is uniform in relative
+    terms, so small innovations — the bulk of a CHOCO delta — quantize
+    far more accurately). Zero chunks get scale 0 and decode to zeros.
+    """
+
+    data: jax.Array  # (padded_n,) float8_e4m3fn
+    scales: jax.Array  # (num_chunks,) float32
+    shape: tuple[int, ...]
+    dtype: Any
+    chunk: int
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.shape, self.dtype, self.chunk)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+
+
 class Compressor(abc.ABC):
     """Stateless, shape-preserving lossy codec for a single array.
 
@@ -157,6 +191,18 @@ class Compressor(abc.ABC):
         low-rank factorization, codecs whose decode of 0 is nonzero) and
         the consensus engine must keep the per-leaf path for it.
         """
+        return None
+
+    def fused_wire(self) -> str | None:
+        """Wire format tag under which this codec's bucket math can run as
+        the FUSED one-pass pack+quantize kernels (see
+        :class:`consensusml_tpu.compress.kernels.FusedBucketCodec` and
+        ``GossipConfig.fused_wire``): ``"int8"``/``"int4"``/``"fp8"`` for
+        the per-chunk symmetric quantizers, ``None`` (default) for
+        everything else — composed/sparse codecs keep the two-step
+        bucketed path. A codec advertising a tag promises that
+        ``compress(bucket)`` equals the fused kernel's payload bit-exactly
+        (parity-tested in tests/test_fused_wire.py)."""
         return None
 
     @abc.abstractmethod

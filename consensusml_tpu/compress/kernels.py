@@ -31,7 +31,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from consensusml_tpu.compress.base import (
+    FP8_E4M3_MAX,
     Compressor,
+    Fp8Payload,
     Int4Payload,
     Int8Payload,
     LocalTopKPayload,
@@ -42,10 +44,16 @@ __all__ = [
     "ChunkedTopKCompressor",
     "PallasInt8Compressor",
     "PallasInt4Compressor",
+    "PallasFp8Compressor",
+    "FusedBucketCodec",
+    "fused_bucket_codec",
+    "resolve_codec_impl",
     "quantize_int8",
     "dequantize_int8",
     "quantize_int4",
     "dequantize_int4",
+    "quantize_fp8",
+    "dequantize_fp8",
     "chunked_topk",
 ]
 
@@ -248,6 +256,56 @@ def dequantize_int4(packed: jax.Array, scales: jax.Array, *, interpret: bool = F
 
 
 # ---------------------------------------------------------------------------
+# fp8 (e4m3) quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def _quant_fp8_kernel(x_ref, q_ref, s_ref):
+    # ONE fp8 quantize definition: the fused wire's (bit-parity between
+    # this standalone codec and FusedBucketCodec is a wire contract)
+    q, scale, _ = _fused_quant(x_ref[:], "fp8")
+    q_ref[:] = q
+    s_ref[:] = scale
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_fp8(chunks: jax.Array, *, interpret: bool = False):
+    """Quantize ``(nchunks, chunk)`` f32 rows to e4m3 + per-row scales:
+    one fused absmax -> scale -> cast pass. ``chunk`` must be a multiple
+    of 128. Returns ``(q (nchunks, chunk) f8e4m3, scales (nchunks,) f32)``."""
+    nchunks, chunk = chunks.shape
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        chunks = jnp.pad(chunks, ((0, rows - nchunks), (0, 0)))
+    q, scales = pl.pallas_call(
+        _quant_fp8_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, chunk), jnp.float8_e4m3fn),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(chunks)
+    return q[:nchunks], scales[:nchunks, 0]
+
+
+def dequantize_fp8(q: jax.Array, scales: jax.Array, *, interpret: bool = False):
+    """Inverse of :func:`quantize_fp8`. The dequant math is dtype-driven
+    (``q.astype(f32) * scale``), so this IS :func:`dequantize_int8`'s
+    kernel fed e4m3 rows — one shared pad/grid/kernel definition."""
+    return dequantize_int8(q, scales, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
 # chunked top-k
 # ---------------------------------------------------------------------------
 
@@ -442,6 +500,22 @@ def _resolve_impl(impl: str) -> str:
     return impl
 
 
+def resolve_codec_impl(requested: str = "auto") -> str:
+    """Resolve a CLI-level codec impl request to the KERNEL path: the
+    compiled Pallas kernels on TPU, the Pallas interpreter elsewhere.
+
+    This differs from the codecs' own ``impl="auto"`` (which falls back
+    to the jnp reference off-TPU, the right default for the CPU test
+    tier): ``train.py --codec int8/int4/fp8`` resolves through THIS so
+    the selected codec always runs the kernel code path — previously
+    "pallas auto" silently meant "jnp" on every non-TPU host and the
+    reported codec never matched the executed one. Callers should log
+    the resolved impl loudly (train.py prints one line)."""
+    if requested != "auto":
+        return requested
+    return "pallas" if _on_tpu() else "interpret"
+
+
 @dataclasses.dataclass(frozen=True)
 class PallasInt8Compressor(Compressor):
     """Per-chunk symmetric int8 codec backed by the Pallas kernels.
@@ -460,6 +534,9 @@ class PallasInt8Compressor(Compressor):
 
     def bucket_alignment(self) -> int | None:
         return self.chunk  # per-chunk scales decompose at chunk boundaries
+
+    def fused_wire(self) -> str | None:
+        return "int8"
 
     def compress(self, x: jax.Array) -> Int8Payload:
         n = x.size
@@ -509,6 +586,9 @@ class PallasInt4Compressor(Compressor):
     def bucket_alignment(self) -> int | None:
         return self.chunk  # _LANE-multiple chunks are always even
 
+    def fused_wire(self) -> str | None:
+        return "int4"
+
     def compress(self, x: jax.Array) -> Int4Payload:
         n = x.size
         chunk = min(self.chunk, _round_up(n, _LANE))
@@ -538,6 +618,59 @@ class PallasInt4Compressor(Compressor):
         packed = payload.data.reshape(-1, payload.chunk // 2)
         flat = dequantize_int4(
             packed, payload.scales, interpret=impl == "interpret"
+        ).reshape(-1)
+        n = 1
+        for d in payload.shape:
+            n *= d
+        return flat[:n].astype(payload.dtype).reshape(payload.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasFp8Compressor(Compressor):
+    """Per-chunk scaled e4m3 codec backed by the fused Pallas kernels
+    (same impl contract as :class:`PallasInt8Compressor`; payload format
+    defined by :class:`~consensusml_tpu.compress.base.Fp8Payload` and the
+    reference semantics by :class:`~consensusml_tpu.compress.reference.
+    Fp8Compressor`)."""
+
+    chunk: int = 512
+    impl: str = "auto"
+
+    def __post_init__(self):
+        if self.chunk % _LANE:
+            raise ValueError(f"chunk must be a multiple of {_LANE}, got {self.chunk}")
+
+    def bucket_alignment(self) -> int | None:
+        return self.chunk  # per-chunk scales decompose at chunk boundaries
+
+    def fused_wire(self) -> str | None:
+        return "fp8"
+
+    def compress(self, x: jax.Array) -> Fp8Payload:
+        n = x.size
+        chunk = min(self.chunk, _round_up(n, _LANE))
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Fp8Compressor
+
+            return Fp8Compressor(chunk=chunk).compress(x)
+        flat = jnp.asarray(x.reshape(-1), jnp.float32)
+        pad = (-n) % chunk
+        chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+        q, scales = quantize_fp8(chunks, interpret=impl == "interpret")
+        return Fp8Payload(
+            data=q.reshape(-1), scales=scales, shape=x.shape, dtype=x.dtype, chunk=chunk
+        )
+
+    def decompress(self, payload: Fp8Payload) -> jax.Array:
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            from consensusml_tpu.compress.reference import Fp8Compressor
+
+            return Fp8Compressor(chunk=payload.chunk).decompress(payload)
+        q = payload.data.reshape(-1, payload.chunk)
+        flat = dequantize_fp8(
+            q, payload.scales, interpret=impl == "interpret"
         ).reshape(-1)
         n = 1
         for d in payload.shape:
@@ -699,3 +832,329 @@ class ChunkedTopKCompressor(Compressor):
         return flat.at[self._global_indices(payload, flat.size)].add(
             vals
         ).reshape(acc.shape)
+
+
+# ---------------------------------------------------------------------------
+# fused gossip wire: one-pass pack+quantize / dequantize+accumulate
+# ---------------------------------------------------------------------------
+#
+# The bucketed CHOCO round's send side is, unfused, a chain of separate
+# XLA programs per bucket: delta = x - xhat (materialized: XLA cannot fuse
+# an elementwise producer INTO a Pallas custom call), the quantize kernel
+# (read delta, write q), the dequantize kernel (read q, write dec_q), and
+# xhat += dec_q — every stage a full HBM round-trip over the bucket. The
+# fused ENCODE below is one kernel per bucket: read (x, xhat), write
+# (q, scales, xhat') — the subtraction, absmax reduction, quantize, wire
+# pack and CHOCO tracking update all happen on the VMEM-resident block.
+# The receive side mirrors it: one DECODE kernel reads s plus every
+# source's (q, scales) and writes s' = s + sum_j w_j dec(q_j), replacing
+# the per-neighbor dequantize + axpy chain.
+#
+# The quantization math is the module-level `_fused_quant`/`_fused_dequant`
+# pair, shared verbatim by the kernel bodies and the jnp impl, so
+# "pallas", "interpret" and "jnp" produce bit-identical payloads — and
+# identical to the UNFUSED codecs (`quantize_int8` / reference
+# `chunk_for_quantization`), which is what lets the fused wire ship the
+# exact same bytes as the two-step path (parity-pinned in
+# tests/test_fused_wire.py).
+
+_FUSED_LEVELS = {"int8": 127.0, "int4": 7.0, "fp8": FP8_E4M3_MAX}
+_FUSED_WIRE_DTYPES = {
+    "int8": jnp.int8,
+    "int4": jnp.uint8,
+    "fp8": jnp.float8_e4m3fn,
+}
+# elements per wire byte-lane: int4 packs two values per byte
+_FUSED_WIRE_PACK = {"int8": 1, "int4": 2, "fp8": 1}
+
+
+def _fused_quant(d: jax.Array, fmt: str):
+    """``(R, chunk)`` f32 delta rows -> ``(wire_data, scales (R, 1),
+    dec (R, chunk))`` — the ONE definition of the fused quantize math
+    (identical to the per-codec reference formulas)."""
+    half = d.shape[1] // 2
+    absmax = jnp.max(jnp.abs(d), axis=1, keepdims=True)
+    scale = absmax / _FUSED_LEVELS[fmt]
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    y = d * inv
+    if fmt == "int8":
+        q = jnp.clip(jnp.rint(y), -127, 127).astype(jnp.int8)
+        return q, scale, q.astype(jnp.float32) * scale
+    if fmt == "int4":
+        qi = jnp.clip(jnp.rint(y), -7, 7).astype(jnp.int32)
+        lo = qi[:, :half] & 0xF
+        hi = (qi[:, half:] & 0xF) << 4
+        return (lo | hi).astype(jnp.uint8), scale, qi.astype(jnp.float32) * scale
+    q = y.astype(jnp.float8_e4m3fn)
+    return q, scale, q.astype(jnp.float32) * scale
+
+
+def _fused_dequant(data: jax.Array, scale: jax.Array, fmt: str) -> jax.Array:
+    """``(R, wire_width)`` wire rows + ``(R, 1)`` scales -> ``(R, chunk)``
+    f32 rows (the decode half of :func:`_fused_quant`)."""
+    if fmt == "int4":
+        b = data.astype(jnp.int32)
+        sext = lambda nib: jnp.where(nib > 7, nib - 16, nib)
+        q = jnp.concatenate([sext(b & 0xF), sext(b >> 4)], axis=1)
+        return q.astype(jnp.float32) * scale
+    return data.astype(jnp.float32) * scale
+
+
+def _fused_encode_kernel(fmt, x_ref, h_ref, q_ref, s_ref, hat_ref):
+    x = x_ref[:]
+    h = h_ref[:]
+    q, scale, dec = _fused_quant(x - h, fmt)
+    q_ref[:] = q
+    s_ref[:] = scale
+    hat_ref[:] = h + dec
+
+
+def _fused_decode_kernel(fmt, weights, s_ref, *rest):
+    # recv accumulates weighted payloads FIRST, s joins last — the exact
+    # float-addition order of the unfused receive (recv = w_self * dec,
+    # then acc + w_j * dec per neighbor, then s + recv), so the fused
+    # wire is bit-identical to the two-step path, not just close
+    *payload_refs, out_ref = rest
+    recv = weights[0] * _fused_dequant(
+        payload_refs[0][:], payload_refs[1][:], fmt
+    )
+    for j, wgt in enumerate(weights[1:], start=1):
+        data = payload_refs[2 * j][:]
+        scale = payload_refs[2 * j + 1][:]
+        recv = recv + wgt * _fused_dequant(data, scale, fmt)
+    out_ref[:] = s_ref[:] + recv
+
+
+def _fused_wire_width(fmt: str, chunk: int) -> int:
+    return chunk // _FUSED_WIRE_PACK[fmt]
+
+
+@functools.partial(jax.jit, static_argnames=("fmt", "interpret"))
+def fused_pack_quantize(
+    x: jax.Array, xhat: jax.Array, *, fmt: str, interpret: bool = False
+):
+    """Fused wire ENCODE: ``q = Q(x - xhat)`` plus the CHOCO tracking
+    update ``xhat' = xhat + dec(q)`` in ONE kernel over ``(nchunks,
+    chunk)`` f32 rows. Returns ``(data, scales (nchunks,), new_xhat)``.
+    ``chunk`` must be a multiple of 128 (even suffices for the jnp impl
+    via :class:`FusedBucketCodec`)."""
+    nchunks, chunk = x.shape
+    width = _fused_wire_width(fmt, chunk)
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
+    rows = _round_up(rows, block_rows)
+    if rows != nchunks:
+        # zero rows quantize to zero with scale 0 and xhat' 0 — inert
+        x = jnp.pad(x, ((0, rows - nchunks), (0, 0)))
+        xhat = jnp.pad(xhat, ((0, rows - nchunks), (0, 0)))
+    cspec = pl.BlockSpec(
+        (block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    data, scales, hat = pl.pallas_call(
+        functools.partial(_fused_encode_kernel, fmt),
+        grid=(rows // block_rows,),
+        in_specs=[cspec, cspec],
+        out_specs=[
+            pl.BlockSpec(
+                (block_rows, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            cspec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, width), _FUSED_WIRE_DTYPES[fmt]),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, chunk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, xhat)
+    return data[:nchunks], scales[:nchunks, 0], hat[:nchunks]
+
+
+@functools.partial(jax.jit, static_argnames=("fmt", "weights", "interpret"))
+def fused_dequantize_accumulate(
+    s: jax.Array, *payload_rows, fmt: str, weights: tuple, interpret: bool = False
+):
+    """Fused wire DECODE: ``s' = s + sum_j weights[j] * dec(q_j)`` in ONE
+    kernel. ``payload_rows`` interleaves ``data_j (nchunks, wire_width)``
+    and ``scales_j (nchunks,)`` per source (self + one per neighbor);
+    ``weights`` are the static mixing weights in the same order."""
+    nchunks, chunk = s.shape
+    width = _fused_wire_width(fmt, chunk)
+    rows = _round_up(max(nchunks, _SUBLANE_I8), _SUBLANE_I8)
+    block_rows = _block_rows(rows, chunk, _SUBLANE_I8)
+    rows = _round_up(rows, block_rows)
+    pad_r = rows - nchunks
+    if pad_r:
+        s = jnp.pad(s, ((0, pad_r), (0, 0)))
+    cspec = pl.BlockSpec(
+        (block_rows, chunk), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    wspec = pl.BlockSpec(
+        (block_rows, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    sspec = pl.BlockSpec(
+        (block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    operands = [s]
+    in_specs = [cspec]
+    if len(payload_rows) != 2 * len(weights):
+        raise ValueError(
+            f"expected (data, scales) per weight: {len(weights)} weights "
+            f"but {len(payload_rows)} payload arrays"
+        )
+    for j in range(len(weights)):
+        data = payload_rows[2 * j]
+        scales = payload_rows[2 * j + 1].reshape(-1, 1)
+        if pad_r:
+            data = jnp.pad(data, ((0, pad_r), (0, 0)))
+            scales = jnp.pad(scales, ((0, pad_r), (0, 0)))
+        operands += [data, scales]
+        in_specs += [wspec, sspec]
+    out = pl.pallas_call(
+        functools.partial(_fused_decode_kernel, fmt, weights),
+        grid=(rows // block_rows,),
+        in_specs=in_specs,
+        out_specs=cspec,
+        out_shape=jax.ShapeDtypeStruct((rows, chunk), jnp.float32),
+        interpret=interpret,
+    )(*operands)
+    return out[:nchunks]
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedBucketCodec:
+    """One-pass pack+quantize wire for a chunk-decomposable quantizer.
+
+    Built by :func:`fused_bucket_codec` from a codec advertising
+    ``Compressor.fused_wire()``; consumed per-bucket by the consensus
+    engine's :class:`~consensusml_tpu.consensus.bucketing.FusedWirePlan`.
+    Operates on FLAT bucket buffers: ``(total,)`` per-worker, or stacked
+    ``(W, total)`` — the buffer is reshaped to chunk rows either way, so
+    the stacked worker axis just contributes more rows and no vmap
+    batching rule is needed for the Pallas calls.
+
+    ``impl`` follows the codec convention: "pallas" (compiled),
+    "interpret" (Pallas interpreter — CPU tests and the jaxpr contract,
+    which counts ``pallas_call`` equations), "jnp" (the same math as
+    plain ops — XLA still fuses the chain, the right default off-TPU),
+    or "auto" (pallas on TPU, jnp elsewhere). All bit-identical.
+    """
+
+    fmt: str  # "int8" | "int4" | "fp8"
+    chunk: int
+    impl: str = "auto"
+
+    def __post_init__(self):
+        if self.fmt not in _FUSED_LEVELS:
+            raise ValueError(f"unknown fused wire format {self.fmt!r}")
+        if self.fmt == "int4" and self.chunk % 2:
+            raise ValueError("int4 fused wire needs an even chunk")
+
+    @property
+    def wire_width(self) -> int:
+        return _fused_wire_width(self.fmt, self.chunk)
+
+    def _payload(self, data, scales, total: int):
+        cls = {"int8": Int8Payload, "int4": Int4Payload, "fp8": Fp8Payload}[
+            self.fmt
+        ]
+        return cls(
+            data=data,
+            scales=scales,
+            shape=(total,),
+            dtype=jnp.dtype(jnp.float32),
+            chunk=self.chunk,
+        )
+
+    def encode(self, x: jax.Array, xhat: jax.Array):
+        """``(payload, new_xhat)`` for one bucket buffer: the codec's
+        exact payload for ``x - xhat`` plus the tracking update
+        ``xhat + dec(payload)``, one fused pass."""
+        lead = x.shape[:-1]
+        total = x.shape[-1]
+        x2 = x.reshape(-1, self.chunk)
+        h2 = xhat.reshape(-1, self.chunk)
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            data, scale, dec = _fused_quant(x2 - h2, self.fmt)
+            scales, hat = scale[:, 0], h2 + dec
+        else:
+            data, scales, hat = fused_pack_quantize(
+                x2, h2, fmt=self.fmt, interpret=impl == "interpret"
+            )
+        payload = self._payload(
+            data.reshape(lead + (-1,)), scales.reshape(lead + (-1,)), total
+        )
+        return payload, hat.reshape(x.shape)
+
+    def decode(self, payload) -> jax.Array:
+        """Dense f32 decode (plain ops — elementwise, XLA fuses it into
+        the consumer; used by the psum/dense receive and the simulated
+        backend's mixing-matrix multiply)."""
+        data = payload.data
+        lead = data.shape[:-1]
+        dec = _fused_dequant(
+            data.reshape(-1, self.wire_width),
+            payload.scales.reshape(-1, 1),
+            self.fmt,
+        )
+        return dec.reshape(lead + (-1,))
+
+    def decode_accumulate(self, s: jax.Array, payloads, weights) -> jax.Array:
+        """``s + sum_j weights[j] * dec(payloads[j])`` in one fused pass
+        — the receive half of the wire (self payload first, then one per
+        neighbor, matching the unfused accumulate order bit-for-bit)."""
+        weights = tuple(float(w) for w in weights)
+        if len(payloads) != len(weights):
+            raise ValueError(
+                f"{len(payloads)} payloads vs {len(weights)} weights"
+            )
+        lead = s.shape[:-1]
+        s2 = s.reshape(-1, self.chunk)
+        impl = _resolve_impl(self.impl)
+        if impl == "jnp":
+            # same term order as the kernel (and the unfused receive):
+            # weighted payload sum first, s last
+            dec = lambda p: _fused_dequant(
+                p.data.reshape(-1, self.wire_width),
+                p.scales.reshape(-1, 1),
+                self.fmt,
+            )
+            recv = weights[0] * dec(payloads[0])
+            for wgt, p in zip(weights[1:], payloads[1:]):
+                recv = recv + wgt * dec(p)
+            return (s2 + recv).reshape(s.shape)
+        flat = []
+        for p in payloads:
+            flat += [
+                p.data.reshape(-1, self.wire_width),
+                p.scales.reshape(-1),
+            ]
+        out = fused_dequantize_accumulate(
+            s2, *flat, fmt=self.fmt, weights=weights,
+            interpret=impl == "interpret",
+        )
+        return out.reshape(s.shape)
+
+
+def fused_bucket_codec(comp) -> FusedBucketCodec | None:
+    """The fused one-pass wire for ``comp``, or ``None`` when the codec
+    cannot ride it (no ``fused_wire()`` tag — composed/sparse codecs —
+    stochastic codecs, or a chunk geometry the kernel tiling rejects).
+    ``None`` means the engine keeps the two-step bucketed path; it is
+    never an error."""
+    fmt = comp.fused_wire()
+    if fmt is None or comp.stochastic:
+        return None
+    align = comp.bucket_alignment()
+    if align is None or align < 2 or (fmt == "int4" and align % 2):
+        return None
+    impl = getattr(comp, "impl", "jnp")
+    if _resolve_impl(impl) != "jnp" and align % _LANE:
+        # a non-lane-multiple chunk cannot tile the kernel path; the jnp
+        # impl has no such constraint
+        return None
+    return FusedBucketCodec(fmt=fmt, chunk=align, impl=impl)

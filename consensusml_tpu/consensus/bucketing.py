@@ -45,7 +45,36 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["BucketLeaf", "Bucket", "BucketPlan", "build_plan"]
+from consensusml_tpu.obs import get_registry
+from consensusml_tpu.obs import span as _span
+
+__all__ = [
+    "BucketLeaf",
+    "Bucket",
+    "BucketPlan",
+    "FusedWirePlan",
+    "build_plan",
+    "build_fused_plan",
+]
+
+# trace-time accounting for the fused wire (same convention as the
+# traced-ppermute counter in comm/collectives.py: gossip programs compile
+# once and replay, so the per-COMPILE kernel count IS the per-round count;
+# zero steady-state cost). One encode and one decode kernel per bucket per
+# innovation exchange is the fused wire's contract — the jaxpr pass
+# (analysis/jaxpr_contracts.check_fused_wire) asserts it on the traced
+# program; these counters surface it to the metrics plane
+# (consensusml_wire_fused_* in docs/observability.md).
+_TRACED_FUSED_ENCODES = get_registry().counter(
+    "consensusml_wire_fused_encodes_traced_total",
+    "fused pack+quantize kernels traced into gossip programs "
+    "(one per bucket per innovation exchange, per XLA compile)",
+)
+_TRACED_FUSED_DECODES = get_registry().counter(
+    "consensusml_wire_fused_decodes_traced_total",
+    "fused dequantize+accumulate kernels traced into gossip programs "
+    "(one per bucket per innovation exchange, per XLA compile)",
+)
 
 
 def _round_up(n: int, align: int) -> int:
@@ -191,3 +220,91 @@ def build_plan(
         close(dtype)
     done.sort(key=lambda b: b.leaves[0].index)
     return BucketPlan(buckets=tuple(done), align=align, n_leaves=len(leaves))
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedWirePlan:
+    """The fused one-pass wire: a :class:`BucketPlan` married to the
+    codec's :class:`~consensusml_tpu.compress.kernels.FusedBucketCodec`.
+
+    Consumed by the consensus engine when ``GossipConfig.fused_wire``
+    engages (bucketed transport + a codec advertising fused kernels):
+    instead of pack -> compress -> decompress -> accumulate as separate
+    XLA programs that each round-trip HBM over every bucket, a gossip
+    round runs exactly ONE encode kernel per bucket on the send side
+    (subtract + absmax + quantize + wire-pack + CHOCO xhat update, all on
+    the VMEM-resident block) and ONE decode kernel per bucket on the
+    receive side (dequantize every source + weighted accumulate into s).
+    Payload bytes and layout are bit-identical to the two-step path —
+    this is a transport fusion, not a codec change.
+
+    All buffer arguments are lists parallel to ``plan.buckets``; each
+    buffer is flat ``(total,)`` per-worker or stacked ``(W, total)`` —
+    the codec reshapes to chunk rows either way (no vmap needed).
+    """
+
+    plan: BucketPlan
+    codec: Any  # compress.kernels.FusedBucketCodec
+
+    @property
+    def num_buckets(self) -> int:
+        return self.plan.num_buckets
+
+    def _check(self, bufs: list, what: str) -> None:
+        if len(bufs) != self.plan.num_buckets:
+            raise ValueError(
+                f"fused wire {what}: plan has {self.plan.num_buckets} "
+                f"buckets, got {len(bufs)} buffers"
+            )
+
+    def encode(self, bufs: list, xhat_bufs: list):
+        """Per bucket: ``(payload, xhat')`` — the codec payload of
+        ``buf - xhat`` plus the tracking update, one kernel each.
+        Returns ``(payloads, new_xhat_bufs)``."""
+        self._check(bufs, "encode")
+        payloads, new_hat = [], []
+        with _span("wire.fused_encode", buckets=len(bufs)):
+            for buf, hat in zip(bufs, xhat_bufs):
+                _TRACED_FUSED_ENCODES.inc()
+                q, h2 = self.codec.encode(buf, hat)
+                payloads.append(q)
+                new_hat.append(h2)
+        return payloads, new_hat
+
+    def decode(self, payloads: list) -> list:
+        """Dense f32 decode per bucket (plain elementwise ops — for the
+        psum receive and the simulated backend's mixing-matrix path)."""
+        self._check(payloads, "decode")
+        return [self.codec.decode(q) for q in payloads]
+
+    def decode_accumulate(
+        self, s_bufs: list, sources: list, weights
+    ) -> list:
+        """Per bucket: ``s + sum_j weights[j] * dec(sources[b][j])`` in
+        one kernel. ``sources[b]`` lists bucket ``b``'s payloads in
+        weight order (self first, then one per neighbor shift)."""
+        self._check(s_bufs, "decode_accumulate")
+        out = []
+        with _span("wire.fused_decode", buckets=len(s_bufs)):
+            for s, plist in zip(s_bufs, sources):
+                _TRACED_FUSED_DECODES.inc()
+                out.append(self.codec.decode_accumulate(s, plist, weights))
+        return out
+
+
+def build_fused_plan(plan: BucketPlan, compressor) -> FusedWirePlan | None:
+    """``FusedWirePlan`` for ``plan`` under ``compressor``, or ``None``
+    when the codec has no fused kernels (composed/sparse/stochastic
+    codecs) — the engine then keeps the two-step bucketed path."""
+    from consensusml_tpu.compress.kernels import fused_bucket_codec
+
+    codec = fused_bucket_codec(compressor)
+    if codec is None:
+        return None
+    if plan.align != codec.chunk:
+        raise ValueError(
+            f"bucket plan alignment {plan.align} != fused codec chunk "
+            f"{codec.chunk}: the plan must be built from this codec's "
+            "bucket_alignment()"
+        )
+    return FusedWirePlan(plan=plan, codec=codec)

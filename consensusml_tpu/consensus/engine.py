@@ -30,7 +30,12 @@ import jax.numpy as jnp
 from consensusml_tpu.comm import collectives, simulated
 from consensusml_tpu.compress.base import Compressor
 from consensusml_tpu.obs import span as _span
-from consensusml_tpu.consensus.bucketing import BucketPlan, build_plan
+from consensusml_tpu.consensus.bucketing import (
+    BucketPlan,
+    FusedWirePlan,
+    build_fused_plan,
+    build_plan,
+)
 from consensusml_tpu.consensus.faults import FaultConfig, masked_mixing_matrix
 from consensusml_tpu.consensus.pushsum import (
     PushSumState,
@@ -56,10 +61,19 @@ class OverlapState(NamedTuple):
     (see ``GossipConfig.overlap``). Exact mode: ``(W - I) z``. Compressed
     (bucketed-path-only) mode: ``gamma * (s - xhat)`` from one CHOCO
     innovation exchange on ``z``, with the tracking state carried in
-    ``choco``."""
+    ``choco``.
+
+    ``pending`` is the pipelined-gossip queue
+    (``GossipConfig.pipeline_depth > 1``): corrections already computed
+    but not yet applied, oldest absent (it lives in ``correction``),
+    newest last — ``len(pending) == pipeline_depth - 1``, so the
+    correction computed at round ``r`` is applied at round ``r +
+    pipeline_depth``. Depth 1 keeps ``pending = ()`` and is bit-identical
+    to the original overlap carry."""
 
     correction: Any  # params-shaped
     choco: Any = None  # ChocoState when overlap rides the compressed path
+    pending: tuple = ()  # in-flight corrections (pipeline_depth - 1 of them)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +185,34 @@ class GossipConfig:
     # PowerSGD, sign) and push-sum rounds keep the per-leaf path
     # automatically. None => always per-leaf (the pre-bucketing wire).
     bucket_bytes: int | None = 4 * 2**20
+    # Fused one-pass wire on the bucketed path: when the codec advertises
+    # fused kernels (``Compressor.fused_wire()`` — the per-chunk int8/
+    # int4/fp8 quantizers), each innovation exchange runs exactly ONE
+    # pack+quantize kernel per bucket on the send side (delta, absmax,
+    # quantize, wire pack and the CHOCO xhat update all in one VMEM pass)
+    # and ONE dequantize+accumulate kernel per bucket on the receive
+    # side, instead of the two-step chain whose every stage round-trips
+    # HBM over the bucket. Payload bytes/layout are bit-identical to the
+    # two-step path (a transport fusion, not a codec change — contrast
+    # ``fused_codec`` above). "auto" (default): engage exactly when the
+    # bucketed path is active and the codec supports it; True: require
+    # it (config error otherwise); False: always two-step.
+    fused_wire: bool | str = "auto"
+    # Pipelined overlap gossip (requires ``overlap=True``): keep D
+    # mixing corrections in flight — the correction computed from round
+    # r's pre-inner params is applied at round r+D, so the collective
+    # issued at round r has D full rounds of local compute to hide
+    # under (cross-round slack for slow links/DCN, where one round's
+    # inner loop is shorter than the wire latency). Each round's
+    # correction is computed from the ANTICIPATED params z + sum(pending)
+    # — the params as they will stand when it lands — which keeps the
+    # shadow sequence on the exact gossip recurrence x <- W x (a naive
+    # delayed correction x_{k+1} = x_k + (W-I) x_{k-D+1} DIVERGES on a
+    # ring for D >= 2: the delay pushes the recurrence's eigenvalues
+    # outside the unit circle). Mean-exact at any depth: every queued
+    # correction sums to zero across workers for doubly stochastic W.
+    # Depth 1 is plain overlap gossip, bit-identical to before.
+    pipeline_depth: int = 1
 
     @property
     def push_sum_enabled(self) -> bool:
@@ -187,6 +229,49 @@ class GossipConfig:
             raise ValueError(
                 f"push_sum must be True, False or 'auto', got {self.push_sum!r}"
             )
+        if self.fused_wire not in (True, False, "auto"):
+            raise ValueError(
+                f"fused_wire must be True, False or 'auto', got "
+                f"{self.fused_wire!r}"
+            )
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.pipeline_depth > 1 and not self.overlap:
+            raise NotImplementedError(
+                "pipeline_depth > 1 is overlap-mode pipelining (corrections "
+                "queued across rounds); it needs overlap=True — without "
+                "overlap the round applies its own mixing immediately and "
+                "there is nothing to pipeline"
+            )
+        if self.fused_wire is True:
+            from consensusml_tpu.compress.kernels import fused_bucket_codec
+
+            if self.compressor is None:
+                raise NotImplementedError(
+                    "fused_wire=True without a compressor has nothing to "
+                    "fuse: exact bucketed mixing is already one collective "
+                    "per bucket"
+                )
+            if (
+                self.bucket_bytes is None
+                or self.fused_codec
+                or self.push_sum_enabled
+            ):
+                raise NotImplementedError(
+                    "fused_wire=True requires the bucketed transport "
+                    "(bucket_bytes set, no fused_codec, no push_sum) — "
+                    "the fused kernels are per-bucket by construction"
+                )
+            if fused_bucket_codec(self.compressor) is None:
+                raise NotImplementedError(
+                    f"fused_wire=True but {type(self.compressor).__name__} "
+                    "advertises no fused wire kernels "
+                    "(Compressor.fused_wire()): only the per-chunk int8/"
+                    "int4/fp8 quantizers fuse; composed/sparse codecs keep "
+                    "the two-step bucketed path (fused_wire='auto')"
+                )
         if self.bucket_bytes is not None and self.bucket_bytes <= 0:
             raise ValueError(
                 f"bucket_bytes must be positive (or None for the per-leaf "
@@ -386,6 +471,29 @@ class ConsensusEngine:
         comp = cfg.compressor
         return comp is None or comp.bucket_alignment() is not None
 
+    @property
+    def fused_wire_active(self) -> bool:
+        """Whether compressed rounds run the FUSED one-pass wire (see
+        ``GossipConfig.fused_wire``): bucketed transport + a codec with
+        fused kernels + the config not opting out. False always for
+        exact mixing (nothing to quantize) and stochastic codecs (no
+        per-round rng threads through the fused kernels)."""
+        cfg = self.config
+        if cfg.compressor is None or cfg.fused_wire is False:
+            return False
+        if not self.bucketed or cfg.compressor.stochastic:
+            return False
+        from consensusml_tpu.compress.kernels import fused_bucket_codec
+
+        return fused_bucket_codec(cfg.compressor) is not None
+
+    def _fused_plan(self, plan: BucketPlan) -> FusedWirePlan | None:
+        """The fused wire for this round's bucket layout (None => the
+        two-step bucketed path stays active)."""
+        if not self.fused_wire_active:
+            return None
+        return build_fused_plan(plan, self.config.compressor)
+
     def _dense_plan(self, leaves: list, stacked: bool = False) -> BucketPlan:
         """Bucket layout for exactly-mixed leaves: original dtypes, no
         alignment padding, capped at the dense (== wire) bytes."""
@@ -579,8 +687,14 @@ class ConsensusEngine:
             if self.config.path_filter is not None:
                 sel, _ = self._select(params)
             correction = jax.tree.map(jnp.zeros_like, sel)
+            # pipeline_depth - 1 further zero corrections in flight: the
+            # first depth-1 rounds apply nothing while the queue fills
+            pending = tuple(
+                jax.tree.map(jnp.zeros_like, sel)
+                for _ in range(self.config.pipeline_depth - 1)
+            )
             if not self.compressed:
-                return OverlapState(correction=correction)
+                return OverlapState(correction=correction, pending=pending)
             # compressed overlap (bucketed path): the correction also
             # carries CHOCO tracking, per-bucket, over the
             # compressed-partition leaves
@@ -589,6 +703,7 @@ class ConsensusEngine:
             return OverlapState(
                 correction=correction,
                 choco=ChocoState(xhat=zeros, s=[jnp.copy(z) for z in zeros]),
+                pending=pending,
             )
         if not self.compressed:
             return None
@@ -747,7 +862,7 @@ class ConsensusEngine:
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
-        plan = treedef = None
+        plan = treedef = fused = None
         xhat, s = state.xhat, state.s
         if self.config.fused_codec:
             # one compress/decompress over the concatenated tree instead
@@ -761,11 +876,16 @@ class ConsensusEngine:
             # buffers cross rounds without a repack.
             leaves, treedef = jax.tree.flatten(x)
             plan = self._codec_plan(leaves)
+            fused = self._fused_plan(plan)
             with _span("bucket.pack", buckets=plan.num_buckets):
                 x = plan.pack(leaves)
             _check_bucket_state(x, xhat)
         def _track(x, xhat, s, it_rng):
             """One innovation exchange: update xhat and s."""
+            if fused is not None:
+                return self._innovation_exchange_fused_collective(
+                    topo, x, xhat, s, fused
+                )
             return self._innovation_exchange_collective(
                 topo, x, xhat, s, it_rng
             )
@@ -887,6 +1007,51 @@ class ConsensusEngine:
         recv = simulated.mix_tree_stacked(dec_q, w)
         return xhat, jax.tree.map(jnp.add, s, recv)
 
+    def _innovation_exchange_fused_collective(
+        self, topo: Topology, x: list, xhat: list, s: list, fused: FusedWirePlan
+    ):
+        """The FUSED one-pass wire's innovation exchange (per-worker
+        view): one pack+quantize kernel per bucket produces the payload
+        AND the xhat update, the payloads ride ``ppermute`` exactly as on
+        the two-step path (same leaves, same bytes, same traced
+        collective count), and one dequantize+accumulate kernel per
+        bucket folds self + every neighbor into ``s``. Bit-identical
+        semantics to :meth:`_innovation_exchange_collective` under the
+        same codec impl — only the number of HBM round-trips changes."""
+        with _span("choco.innovation", fused=True):
+            q, xhat = fused.encode(x, xhat)
+            if topo.uses_psum:
+                # dense: pmean over the decoded innovation, as unfused
+                dec = fused.decode(q)
+                recv = [jax.lax.pmean(d, topo.axis_names) for d in dec]
+                return xhat, [si + r for si, r in zip(s, recv)]
+            with _span("choco.exchange", shifts=len(topo.shifts)):
+                # all shifts' sends up front: bucket i+1's encode has no
+                # data dependence on bucket i's in-flight ppermute
+                inflight = [
+                    collectives.ppermute_shift_tree(q, topo, shift)
+                    for shift in topo.shifts
+                ]
+            weights = (topo.self_weight,) + tuple(
+                sh.weight for sh in topo.shifts
+            )
+            sources = [
+                [qb] + [nbr[i] for nbr in inflight] for i, qb in enumerate(q)
+            ]
+            return xhat, fused.decode_accumulate(s, sources, weights)
+
+    def _innovation_exchange_fused_simulated(
+        self, x: list, xhat: list, s: list, w: jax.Array, fused: FusedWirePlan
+    ):
+        """Stacked-backend fused exchange: the SAME encode kernels run
+        over the stacked ``(W, total)`` buffers (the worker axis just
+        adds chunk rows), then the decoded innovations mix through the
+        matrix — the cross-validation oracle for the collective path."""
+        q, xhat = fused.encode(x, xhat)
+        dec = fused.decode(q)
+        recv = [simulated.mix_stacked(d, w) for d in dec]
+        return xhat, [si + r for si, r in zip(s, recv)]
+
     # ---- overlap gossip (combine-then-adapt) ----------------------------
     def apply_correction(self, tree: Any, state: OverlapState) -> Any:
         """Start-of-round combine: add last round's ``(W - I) z`` to the
@@ -896,15 +1061,35 @@ class ConsensusEngine:
             return rebuild(jax.tree.map(jnp.add, sel, state.correction))
         return jax.tree.map(jnp.add, tree, state.correction)
 
-    def _correction(self, mix_fn, tree: Any) -> OverlapState:
+    def _correction(self, mix_fn, tree: Any, pending: tuple) -> Any:
+        """The next correction ``(W - I) z_hat`` from this round's
+        pre-inner params. ``z_hat`` anticipates the still-queued
+        corrections (``pending``) so that under ``pipeline_depth > 1``
+        the correction is computed against the params AS THEY WILL STAND
+        when it finally lands — the shadow sequence then follows the
+        plain gossip recurrence at any depth (see
+        ``GossipConfig.pipeline_depth``; a naive delayed ``(W - I) z``
+        diverges for depth >= 2)."""
         sel = tree
         if self.config.path_filter is not None:
             sel, _ = self._select(tree)
+        for p in pending:
+            sel = jax.tree.map(jnp.add, sel, p)
         mixed = mix_fn(sel)
+        return jax.tree.map(
+            lambda m, t: (m - t).astype(t.dtype), mixed, sel
+        )
+
+    def _push_correction(
+        self, state: OverlapState | None, corr: Any, choco: Any
+    ) -> OverlapState:
+        """Rotate the pipeline queue: this round's (just-applied) head
+        drops, ``corr`` joins at the back. Depth 1 degenerates to the
+        original single-correction carry."""
+        pending = () if state is None else tuple(state.pending)
+        queue = pending + (corr,)
         return OverlapState(
-            correction=jax.tree.map(
-                lambda m, t: (m - t).astype(t.dtype), mixed, sel
-            )
+            correction=queue[0], choco=choco, pending=queue[1:]
         )
 
     def _correction_compressed(
@@ -918,7 +1103,14 @@ class ConsensusEngine:
         overlap correction, and Metropolis-doubly-stochastic W keeps
         ``sum_i (s_i - xhat_i) = 0`` so the delayed application is
         mean-exact. ``stacked_w``: mixing matrix => simulated backend.
+        Returns ``(correction, choco)``; the caller rotates the pipeline
+        queue (:meth:`_push_correction`).
         """
+        for p in state.pending:
+            # pipeline_depth > 1: anticipate the still-queued corrections
+            # so the innovation tracks the params as they will stand when
+            # this correction lands (see _correction)
+            tree = jax.tree.map(jnp.add, tree, p)
         f32 = lambda t: jax.tree.map(lambda v: jnp.asarray(v, jnp.float32), t)
         ctree, exact_leaves, rest_leaves, rebuild_split = self._partition(
             tree
@@ -926,12 +1118,22 @@ class ConsensusEngine:
         stacked = stacked_w is not None
         leaves, treedef = jax.tree.flatten(f32(ctree))
         plan = self._codec_plan(leaves, stacked=stacked)
+        fused = self._fused_plan(plan)
         x = plan.pack(leaves, stacked=stacked)
         xhat, s = state.choco.xhat, state.choco.s  # already per-bucket
         _check_bucket_state(x, xhat)
         if stacked:
-            xhat, s = self._innovation_exchange_simulated(
-                x, xhat, s, stacked_w, None
+            if fused is not None:
+                xhat, s = self._innovation_exchange_fused_simulated(
+                    x, xhat, s, stacked_w, fused
+                )
+            else:
+                xhat, s = self._innovation_exchange_simulated(
+                    x, xhat, s, stacked_w, None
+                )
+        elif fused is not None:
+            xhat, s = self._innovation_exchange_fused_collective(
+                topo, x, xhat, s, fused
             )
         else:
             xhat, s = self._innovation_exchange_collective(
@@ -948,7 +1150,7 @@ class ConsensusEngine:
         )
         choco = ChocoState(xhat=xhat, s=s)  # stays per-bucket
         if rebuild_split is None:
-            return OverlapState(correction=corr_c, choco=choco)
+            return corr_c, choco
         # exact-partition leaves (BN stats under the "auto" filter) get
         # the plain (W - I) z correction; path_filter is rejected at
         # config time, so the passthrough list is always empty here
@@ -960,10 +1162,7 @@ class ConsensusEngine:
             (m - e).astype(e.dtype) for m, e in zip(mixed, exact_leaves)
         ]
         zeros_r = [jnp.zeros_like(r) for r in rest_leaves]
-        return OverlapState(
-            correction=rebuild_split(jax.tree.leaves(corr_c), corr_e, zeros_r),
-            choco=choco,
-        )
+        return rebuild_split(jax.tree.leaves(corr_c), corr_e, zeros_r), choco
 
     def correction_collective(
         self, tree: Any, state: OverlapState | None = None,
@@ -984,21 +1183,35 @@ class ConsensusEngine:
                     "CHOCO tracking (from init_state)"
                 )
             if not topo.is_time_varying:
-                return self._correction_compressed(topo, tree, state)
-            if step is None:
-                raise ValueError(
-                    f"{type(topo).__name__} is time-varying: "
-                    "correction_collective needs the round counter (step=...)"
+                corr, choco = self._correction_compressed(topo, tree, state)
+            else:
+                if step is None:
+                    raise ValueError(
+                        f"{type(topo).__name__} is time-varying: "
+                        "correction_collective needs the round counter "
+                        "(step=...)"
+                    )
+                branches = [
+                    functools.partial(self._correction_compressed, phase)
+                    for phase in topo.phases
+                ]
+                corr, choco = jax.lax.switch(
+                    step % topo.period, branches, tree, state
                 )
-            branches = [
-                functools.partial(self._correction_compressed, phase)
-                for phase in topo.phases
-            ]
-            return jax.lax.switch(step % topo.period, branches, tree, state)
-        if not topo.is_time_varying:
-            return self._correction(
-                lambda t: self._mix_exact_tree_collective(t, topo), tree
+            return self._push_correction(state, corr, choco)
+        if state is None and self.config.pipeline_depth > 1:
+            raise ValueError(
+                "pipeline_depth > 1 needs the current OverlapState (the "
+                "in-flight correction queue) passed to "
+                "correction_collective"
             )
+        pending = () if state is None else tuple(state.pending)
+        if not topo.is_time_varying:
+            corr = self._correction(
+                lambda t: self._mix_exact_tree_collective(t, topo), tree,
+                pending,
+            )
+            return self._push_correction(state, corr, None)
         if step is None:
             raise ValueError(
                 f"{type(topo).__name__} is time-varying: "
@@ -1007,13 +1220,15 @@ class ConsensusEngine:
         branches = [
             functools.partial(
                 lambda phase, t: self._correction(
-                    lambda s: self._mix_exact_tree_collective(s, phase), t
+                    lambda s: self._mix_exact_tree_collective(s, phase), t,
+                    pending,
                 ),
                 phase,
             )
             for phase in topo.phases
         ]
-        return jax.lax.switch(step % topo.period, branches, tree)
+        corr = jax.lax.switch(step % topo.period, branches, tree)
+        return self._push_correction(state, corr, None)
 
     def correction_simulated(
         self, tree: Any, w: jax.Array, state: OverlapState | None = None
@@ -1027,12 +1242,20 @@ class ConsensusEngine:
                     "compressed overlap needs the OverlapState carrying "
                     "CHOCO tracking (from init_state)"
                 )
-            return self._correction_compressed(
+            corr, choco = self._correction_compressed(
                 self.topology, tree, state, stacked_w=w
             )
-        return self._correction(
-            lambda t: self._mix_exact_tree_simulated(t, w), tree
+            return self._push_correction(state, corr, choco)
+        if state is None and self.config.pipeline_depth > 1:
+            raise ValueError(
+                "pipeline_depth > 1 needs the current OverlapState (the "
+                "in-flight correction queue) passed to correction_simulated"
+            )
+        pending = () if state is None else tuple(state.pending)
+        corr = self._correction(
+            lambda t: self._mix_exact_tree_simulated(t, w), tree, pending
         )
+        return self._push_correction(state, corr, None)
 
     # ---- simulated backend (stacked leading worker axis) ----------------
     def round_simulated(
@@ -1116,7 +1339,7 @@ class ConsensusEngine:
         f32 = lambda t: jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), t)
         x = f32(params)
         unravel = None
-        plan = treedef = None
+        plan = treedef = fused = None
         xhat, s = state.xhat, state.s
         if self.config.fused_codec:
             # same flatten boundary as the collective backend: per-worker
@@ -1128,6 +1351,7 @@ class ConsensusEngine:
             # per-bucket (init_state with world_size)
             leaves, treedef = jax.tree.flatten(x)
             plan = self._codec_plan(leaves, stacked=True)
+            fused = self._fused_plan(plan)
             with _span("bucket.pack", buckets=plan.num_buckets):
                 x = plan.pack(leaves, stacked=True)
             _check_bucket_state(x, xhat)
@@ -1137,6 +1361,10 @@ class ConsensusEngine:
             # collective backend runs, so the per-leaf rng fold-in
             # convention has one source of truth and the backends draw
             # identical randomness (incl. the per-iteration fold)
+            if fused is not None:
+                return self._innovation_exchange_fused_simulated(
+                    x, xhat, s, w, fused
+                )
             return self._innovation_exchange_simulated(x, xhat, s, w, it_rng)
 
         if comp.stochastic and rng is None:
@@ -1301,12 +1529,25 @@ class ConsensusEngine:
         # not the per-send size, and the ratio is dense vs ONE payload
         # (the codec's compression), not vs the round's repeat count
         per_send = wire / sends / max(self.config.gossip_steps, 1)
+        fused_buckets = (
+            plan.num_buckets if plan is not None and self.fused_wire_active
+            else 0
+        )
+        # kernel launches one fused round traces: encode + decode per
+        # bucket per innovation exchange (psum topologies decode via the
+        # reduction, so only the encode kernel runs)
+        stages = 1 if self.topology.uses_psum else 2
         return {
             "wire_bytes_per_round": float(wire),
             "wire_bytes_per_neighbor": float(per_send),
             "gossip_buckets": float(plan.num_buckets) if plan else 0.0,
             "compression_ratio": float(dense / per_send) if wire else 0.0,
             "neighbor_sends_per_round": float(sends),
+            "wire_fused_buckets": float(fused_buckets),
+            "wire_fused_kernel_calls_per_round": float(
+                stages * fused_buckets * self.config.gossip_steps
+            ),
+            "gossip_pipeline_depth": float(self.config.pipeline_depth),
         }
 
     def choco_residual(self, state: Any) -> float | None:

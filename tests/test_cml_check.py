@@ -440,6 +440,41 @@ def test_jaxpr_decode_contracts_run_on_lm_configs_only():
     assert _check_decode_jaxpr("mnist_mlp", configs.build("mnist_mlp")) == []
 
 
+def test_fused_wire_contract_is_clean():
+    """ISSUE 9 CI satellite: the fused one-pass wire traces exactly one
+    pallas_call per bucket per kernel stage (encode+decode on ppermute
+    topologies, encode-only on psum) and its traced ppermute count still
+    matches the schedule verifier's model."""
+    from consensusml_tpu.analysis import jaxpr_contracts
+
+    fs = jaxpr_contracts.check_fused_wire()
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_fused_wire_contract_catches_unfused_fallback():
+    """The fused-active rule fires when the fused wire silently falls
+    back: the kernel-count rule fires when the traced program's
+    pallas_call count drifts from the per-bucket contract (simulated
+    here by lying to the checker about the expected count via a codec
+    that never fuses — the fused-active finding is the canary)."""
+    import consensusml_tpu.compress as C
+    import consensusml_tpu.analysis.jaxpr_contracts as jc
+
+    # a codec class whose instances refuse to fuse: auto-mode engines
+    # silently keep the two-step path, which the contract must flag
+    class NoFuse(C.PallasInt8Compressor):
+        def fused_wire(self):
+            return None
+
+    real = C.PallasInt8Compressor
+    C.PallasInt8Compressor = NoFuse
+    try:
+        fs = jc.check_fused_wire()
+    finally:
+        C.PallasInt8Compressor = real
+    assert "fused-active" in _rules(fs), [f.render() for f in fs]
+
+
 def test_jaxpr_callback_detector_sees_callbacks():
     import jax
     import jax.numpy as jnp

@@ -65,10 +65,18 @@ def parse_args(argv=None):
                         'ring-of-rings — inner ring on ICI every round, '
                         'inter-slice ring on DCN 1-in-K rounds)')
     p.add_argument("--codec", default=None,
-                   choices=["topk_int8", "topk_int4"],
+                   choices=["topk_int8", "topk_int4", "int8", "int4", "fp8"],
                    help="swap the compressed-gossip codec on a compressed "
-                        "config (topk_int4 = half the wire of the config-5 "
-                        "default; same top-k, 4-bit value quantization)")
+                        "config. topk_int8/topk_int4: sparsify then "
+                        "quantize the surviving values (topk_int4 = half "
+                        "the wire of the config-5 default). int8/int4/fp8: "
+                        "the pure per-chunk quantizers — denser wire, but "
+                        "they ride the FUSED one-pass bucketed wire (one "
+                        "pack+quantize kernel per bucket per round; see "
+                        "docs/gossip_bucketing.md). These resolve to the "
+                        "compiled Pallas kernels on TPU and the Pallas "
+                        "interpreter elsewhere — the chosen path is logged "
+                        "loudly at startup")
     p.add_argument("--gossip-steps", type=int, default=None,
                    help="consensus iterations per round (wire x N): N "
                         "small-gamma CHOCO iterations contract like N "
@@ -91,6 +99,15 @@ def parse_args(argv=None):
                         "round, letting XLA overlap the communication with "
                         "the H local steps (exact gossip, or compressed "
                         "gossip on the bucketed wire)")
+    p.add_argument("--gossip-pipeline", type=int, default=None, metavar="D",
+                   help="pipelined overlap gossip: keep D mixing "
+                        "corrections in flight (requires --overlap-gossip "
+                        "or an overlap config) — the correction computed "
+                        "at round r lands at round r+D, so each round's "
+                        "collective has D rounds of local compute to hide "
+                        "under (cross-round slack for slow links/DCN). "
+                        "D=1 is plain overlap gossip, bit-identical to "
+                        "--overlap-gossip alone")
     p.add_argument("--bucket-bytes", type=int, default=None,
                    help="gossip wire bucket cap in bytes — leaves coalesce "
                         "into fused wire buffers of roughly this much "
@@ -444,14 +461,14 @@ def main(argv=None) -> int:
             )
             return 2
         from consensusml_tpu.compress import (
+            PallasFp8Compressor,
+            PallasInt4Compressor,
+            PallasInt8Compressor,
+            resolve_codec_impl,
             topk_int4_compressor,
             topk_int8_compressor,
         )
 
-        make = {
-            "topk_int8": topk_int8_compressor,
-            "topk_int4": topk_int4_compressor,
-        }[args.codec]
         # preserve the config's sparsity/chunking and change ONLY the
         # quantizer width: read chunk and k (or ratio) off the current
         # compressor rather than hardcoding, so a config whose codec
@@ -467,13 +484,41 @@ def main(argv=None) -> int:
             or getattr(cur, "chunk", None)
             or (512 if scale == "full" else 128)
         )
-        k = getattr(inner, "k_per_chunk", None) or getattr(inner, "k", None)
-        if k is not None:
-            comp = make(chunk=chunk, k=k, impl="auto")
-        else:
-            comp = make(
-                ratio=getattr(inner, "ratio", 0.1), chunk=chunk, impl="auto"
+        if args.codec in ("int8", "int4", "fp8"):
+            # pure per-chunk quantizers: resolve "pallas auto" for real —
+            # compiled kernels on TPU, interpreter fallback elsewhere (the
+            # codec-level "auto" would silently run the jnp reference off
+            # TPU and the reported codec would not be the executed one)
+            impl = resolve_codec_impl()
+            chunk = -(-chunk // 128) * 128  # kernel tiling: lane multiple
+            comp = {
+                "int8": PallasInt8Compressor,
+                "int4": PallasInt4Compressor,
+                "fp8": PallasFp8Compressor,
+            }[args.codec](chunk=chunk, impl=impl)
+            path = (
+                "compiled pallas kernels (tpu)"
+                if impl == "pallas"
+                else "pallas interpret fallback "
+                f"({jax.default_backend()} backend, no TPU)"
             )
+            print(
+                f"codec: {args.codec}/{chunk} -> {path}; fused one-pass "
+                "bucketed wire engages automatically (fused_wire=auto)",
+                flush=True,
+            )
+        else:
+            make = {
+                "topk_int8": topk_int8_compressor,
+                "topk_int4": topk_int4_compressor,
+            }[args.codec]
+            k = getattr(inner, "k_per_chunk", None) or getattr(inner, "k", None)
+            if k is not None:
+                comp = make(chunk=chunk, k=k, impl="auto")
+            else:
+                comp = make(
+                    ratio=getattr(inner, "ratio", 0.1), chunk=chunk, impl="auto"
+                )
         bundle.cfg = dataclasses.replace(
             bundle.cfg,
             gossip=dataclasses.replace(bundle.cfg.gossip, compressor=comp),
@@ -537,6 +582,19 @@ def main(argv=None) -> int:
             )
         except NotImplementedError as e:
             print(f"error: --overlap-gossip: {e}", file=sys.stderr)
+            return 2
+    if args.gossip_pipeline is not None:
+        import dataclasses
+
+        try:
+            bundle.cfg = dataclasses.replace(
+                bundle.cfg,
+                gossip=dataclasses.replace(
+                    bundle.cfg.gossip, pipeline_depth=args.gossip_pipeline
+                ),
+            )
+        except (NotImplementedError, ValueError) as e:
+            print(f"error: --gossip-pipeline: {e}", file=sys.stderr)
             return 2
     if args.slowmo_beta is not None:
         import dataclasses
