@@ -1,6 +1,6 @@
 """cml-check: JAX-aware static analysis for the gossip training stack.
 
-Four passes (CLI: ``tools/cml_check.py --all``; docs:
+Five passes (CLI: ``tools/cml_check.py --all``; docs:
 ``docs/static_analysis.md``):
 
 - :mod:`~consensusml_tpu.analysis.host_sync` — AST lint for host/device
@@ -17,6 +17,9 @@ Four passes (CLI: ``tools/cml_check.py --all``; docs:
 - :mod:`~consensusml_tpu.analysis.locks` — lock-discipline race lint
   over :func:`guarded_by`-annotated classes (the threaded host side:
   prefetcher, native ring, metrics registry, watchdog).
+- :mod:`~consensusml_tpu.analysis.docs_drift` — metric-schema drift:
+  every ``consensusml_*`` family emitted in code must appear in
+  ``docs/observability.md``, and doc entries no code emits are stale.
 
 This ``__init__`` stays import-light (annotations + findings only, no
 jax): runtime modules import :func:`guarded_by` from here at module

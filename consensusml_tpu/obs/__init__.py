@@ -12,6 +12,20 @@ Three pillars (see docs/observability.md for the full schema):
   the span ring + last-K metric snapshots to a timestamped JSON file on
   watchdog timeout, unhandled exception, or SIGTERM.
 
+The REQUEST plane makes serving explain itself per request
+(docs/observability.md "Request tracing"):
+
+- :mod:`~consensusml_tpu.obs.requests` — ``TraceContext`` propagation +
+  a bounded per-request ``RequestTrace`` registry (submit → admission →
+  prefill → decode → completion, with deferral/preemption/hot-swap
+  events), merged into the Chrome trace and the flight-recorder dump;
+- exemplar-bearing SLO histograms (``Histogram.observe(v, exemplar=)``)
+  so a p99 bucket resolves to concrete request ids;
+- :mod:`~consensusml_tpu.obs.httpd` — a stdlib ``ThreadingHTTPServer``
+  serving ``/metrics`` (live Prometheus text), ``/traces`` and
+  ``/requests`` (``train.py --metrics-port``,
+  ``ServeServer(metrics_port=...)``).
+
 The CLUSTER plane builds on them (docs/observability.md "Cluster view"):
 
 - :mod:`~consensusml_tpu.obs.links` — per-link probes feeding
@@ -37,6 +51,7 @@ from consensusml_tpu.obs.cluster import (  # noqa: F401
     read_snapshots,
 )
 from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
+from consensusml_tpu.obs.httpd import MetricsServer  # noqa: F401
 from consensusml_tpu.obs.health import (  # noqa: F401
     ConsensusHealthMonitor,
     decay_bound,
@@ -55,6 +70,13 @@ from consensusml_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     parse_metric_key,
+)
+from consensusml_tpu.obs.requests import (  # noqa: F401
+    RequestTrace,
+    RequestTraceRegistry,
+    TraceContext,
+    get_request_registry,
+    merged_chrome_trace,
 )
 from consensusml_tpu.obs.tracer import (  # noqa: F401
     SpanTracer,

@@ -60,12 +60,18 @@ class ClusterWriter:
         role: str = "rank",
         registry: MetricsRegistry | None = None,
         world_size: int | None = None,
+        tracer=None,
     ):
+        from consensusml_tpu.obs.tracer import get_tracer
+
         self.out_dir = out_dir
         self.rank = int(rank)
         self.role = role
         self.world_size = world_size
         self.registry = registry if registry is not None else get_registry()
+        # span-ring digest source: per-round phase rows for the merged
+        # round timeline (tracer disabled => no digest in the snapshot)
+        self.tracer = tracer if tracer is not None else get_tracer()
         os.makedirs(out_dir, exist_ok=True)
         self.path = os.path.join(
             out_dir, f"{SNAP_PREFIX}{role}-{self.rank:05d}.json"
@@ -97,6 +103,10 @@ class ClusterWriter:
         }
         if self._events:
             doc["swarm_events"] = list(self._events)
+        if self.tracer is not None and self.tracer.enabled:
+            digest = self.tracer.digest()
+            if digest["spans"]:
+                doc["span_digest"] = digest
         if extra:
             doc.update(extra)
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -156,12 +166,15 @@ def hist_stats(vd: dict[str, Any]) -> dict[str, float]:
 
 def _merge_hist(a: dict[str, Any] | None, b: dict[str, Any]) -> dict[str, Any]:
     if a is None:
-        return {
+        out = {
             "count": b.get("count", 0),
             "sum": b.get("sum", 0.0),
             "buckets": dict(b.get("buckets", {})),
             "inf": b.get("inf", 0),
         }
+        if b.get("exemplars"):
+            out["exemplars"] = list(b["exemplars"])
+        return out
     out = dict(a)
     out["count"] = a.get("count", 0) + b.get("count", 0)
     out["sum"] = a.get("sum", 0.0) + b.get("sum", 0.0)
@@ -170,6 +183,14 @@ def _merge_hist(a: dict[str, Any] | None, b: dict[str, Any]) -> dict[str, Any]:
     for le, c in b.get("buckets", {}).items():
         buckets[le] = buckets.get(le, 0) + c
     out["buckets"] = buckets
+    # worst exemplars survive the merge, capped like the per-histogram
+    # retention
+    ex = list(a.get("exemplars", [])) + list(b.get("exemplars", []))
+    if ex:
+        from consensusml_tpu.obs.metrics import EXEMPLAR_KEEP
+
+        ex.sort(key=lambda e: -e.get("value", 0.0))
+        out["exemplars"] = ex[:EXEMPLAR_KEEP]
     return out
 
 
@@ -184,6 +205,149 @@ def _finite(v) -> float | None:
     except (TypeError, ValueError):
         return None
     return f if math.isfinite(f) else None
+
+
+_SLO_SIDES = {
+    "consensusml_serve_ttft_seconds": "server",
+    "consensusml_serve_prefill_seconds": "server",
+    "consensusml_serve_intertoken_seconds": "server",
+    "consensusml_loadgen_ttft_seconds": "client",
+    "consensusml_loadgen_latency_seconds": "client",
+}
+
+
+def _requests_section(snaps: list[dict[str, Any]], top: int = 8) -> dict[str, Any]:
+    """The serving-request view: merge every snapshot's request-trace
+    dump into one id index, then resolve the SLO histograms' exemplars
+    against it — the "slowest requests" table where a p99 bucket's
+    request_id points at a concrete recorded trace (client and server
+    observations of one request join on trace_id)."""
+    index: dict[str, dict[str, Any]] = {}
+    for s in snaps:
+        rt = s.get("request_traces") or {}
+        for tr in list(rt.get("active", [])) + list(rt.get("completed", [])):
+            rid = tr.get("request_id")
+            if rid:
+                index[rid] = {
+                    "trace_id": tr.get("trace_id"),
+                    "finish_reason": tr.get("finish_reason"),
+                    "decode_ticks": tr.get("decode_ticks", 0),
+                    "defer_ticks": tr.get("defer_ticks", 0),
+                    "preemptions": tr.get("preemptions", 0),
+                    "events": [e.get("name") for e in tr.get("events", [])],
+                    "in_flight": tr.get("finish_reason") is None,
+                }
+    rows: list[dict[str, Any]] = []
+    for s in snaps:
+        for key, vd in s.get("metrics", {}).items():
+            name, _labels = parse_metric_key(key)
+            side = _SLO_SIDES.get(name)
+            if side is None or not isinstance(vd, dict):
+                continue
+            for ex in vd.get("exemplars", []):
+                rid = ex.get("id")
+                tr = index.get(rid)
+                rows.append(
+                    {
+                        "metric": name,
+                        "side": side,
+                        "value_s": ex.get("value"),
+                        "request_id": rid,
+                        "trace_id": tr["trace_id"] if tr else None,
+                        "resolved": tr is not None,
+                        "role": s.get("role"),
+                        "rank": s.get("rank"),
+                        "trace": tr,
+                    }
+                )
+    rows.sort(
+        key=lambda r: (
+            r["metric"], -(r["value_s"] or 0.0), r["request_id"] or ""
+        )
+    )
+    slowest: list[dict[str, Any]] = []
+    per_metric: dict[str, int] = {}
+    for r in rows:
+        n = per_metric.get(r["metric"], 0)
+        if n < top:
+            per_metric[r["metric"]] = n + 1
+            slowest.append(r)
+    return {
+        "traces_indexed": len(index),
+        "in_flight": sum(1 for t in index.values() if t["in_flight"]),
+        "slowest": slowest,
+    }
+
+
+def _round_timeline(ranks: list[dict[str, Any]], max_rounds: int = 64) -> list[dict[str, Any]]:
+    """Cross-rank per-round phase rows from the span digests.
+
+    Each rank's ``span_digest.rounds`` carries measured ``train.round``
+    duration plus the ``round.feed`` / ``round.fence`` phase spans; the
+    merged timeline shows, per round, every rank's split and attributes
+    the straggler's EXTRA time (vs the fastest rank) to a phase:
+    ``feed`` when the feed-stall delta dominates, else ``gossip`` /
+    ``compute`` split by the rank's compile-round span ratio (an
+    estimate — the steady-state jitted round is one program; marked
+    ``_est`` accordingly)."""
+    per_round: dict[int, list[dict[str, Any]]] = {}
+    ratios: dict[Any, float] = {}
+    for s in ranks:
+        digest = s.get("span_digest") or {}
+        spans = digest.get("spans") or {}
+        gossip_us = (spans.get("gossip.round") or {}).get("total_us", 0.0)
+        inner_us = (spans.get("train.inner_loop") or {}).get("total_us", 0.0)
+        ratios[s.get("rank")] = (
+            gossip_us / (gossip_us + inner_us)
+            if gossip_us + inner_us > 0
+            else None
+        )
+        for row in digest.get("rounds", []):
+            rnd = row.get("round")
+            if rnd is None:
+                continue
+            per_round.setdefault(int(rnd), []).append(
+                {
+                    "rank": s.get("rank"),
+                    "dur_ms": round(row.get("dur_us", 0.0) / 1e3, 3),
+                    "feed_ms": round(row.get("feed_us", 0.0) / 1e3, 3),
+                    "fence_ms": round(row.get("fence_us", 0.0) / 1e3, 3),
+                }
+            )
+    timeline: list[dict[str, Any]] = []
+    for rnd in sorted(per_round)[-max_rounds:]:
+        rows = sorted(per_round[rnd], key=lambda r: (r["rank"] is None, r["rank"]))
+        slow = max(rows, key=lambda r: r["dur_ms"])
+        fast = min(rows, key=lambda r: r["dur_ms"])
+        entry: dict[str, Any] = {"round": rnd, "ranks": rows}
+        if len(rows) > 1 and slow["dur_ms"] > fast["dur_ms"]:
+            extra = slow["dur_ms"] - fast["dur_ms"]
+            feed_delta = max(slow["feed_ms"] - fast["feed_ms"], 0.0)
+            feed_delta = min(feed_delta, extra)
+            rest = extra - feed_delta
+            ratio = ratios.get(slow["rank"])
+            gossip_est = rest * ratio if ratio is not None else None
+            compute_est = rest - gossip_est if gossip_est is not None else None
+            parts = {"feed": feed_delta}
+            if gossip_est is not None:
+                parts["gossip"] = gossip_est
+                parts["compute"] = compute_est
+            else:
+                parts["step"] = rest  # no compile ratio: unattributed
+            entry["straggler"] = {
+                "rank": slow["rank"],
+                "extra_ms": round(extra, 3),
+                "feed_ms": round(feed_delta, 3),
+                "gossip_ms_est": (
+                    None if gossip_est is None else round(gossip_est, 3)
+                ),
+                "compute_ms_est": (
+                    None if compute_est is None else round(compute_est, 3)
+                ),
+                "phase": max(parts, key=lambda k: parts[k]),
+            }
+        timeline.append(entry)
+    return timeline
 
 
 def aggregate(
@@ -480,6 +644,12 @@ def aggregate(
         "stragglers": stragglers,
         "churn": churn,
         "membership": membership,
+        # the request plane: slowest-request exemplar table resolved
+        # against the merged trace index (docs/observability.md
+        # "Request tracing")
+        "requests": _requests_section(ranks + others),
+        # cross-rank per-round phase rows from the span digests
+        "round_timeline": _round_timeline(ranks),
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

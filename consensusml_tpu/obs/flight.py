@@ -13,6 +13,11 @@ one of three triggers fires:
   wedged collective still leaves evidence (the exact scenario the
   watchdog exists for);
 - **unhandled exception** — a chained ``sys.excepthook``;
+- **unhandled THREAD exception** — a chained ``threading.excepthook``:
+  the serve-engine loop re-raises device errors on its own thread, so a
+  serving crash dumps too, and the dump's ``request_traces`` section
+  (the request-trace registry snapshot, in-flight streams included) is
+  what makes the dying engine's open requests visible post-mortem;
 - **SIGTERM** — a chained signal handler (the launcher's preemption
   path), which re-raises the previous disposition so the process still
   terminates.
@@ -27,11 +32,16 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 import traceback
 from typing import Any
 
 from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry
+from consensusml_tpu.obs.requests import (
+    RequestTraceRegistry,
+    get_request_registry,
+)
 from consensusml_tpu.obs.tracer import SpanTracer, get_tracer
 
 __all__ = ["FlightRecorder"]
@@ -43,12 +53,17 @@ class FlightRecorder:
         out_dir: str,
         tracer: SpanTracer | None = None,
         registry: MetricsRegistry | None = None,
+        requests: RequestTraceRegistry | None = None,
     ):
         self.out_dir = out_dir
         self.tracer = tracer if tracer is not None else get_tracer()
         self.registry = registry if registry is not None else get_registry()
+        self.requests = (
+            requests if requests is not None else get_request_registry()
+        )
         self._installed = False
         self._prev_excepthook = None
+        self._prev_thread_hook = None
         self._prev_sigterm = None
         self.last_dump_path: str | None = None
 
@@ -77,6 +92,10 @@ class FlightRecorder:
                 "metrics_final": self.registry.snapshot(
                     {"flight_recorder_reason": reason}
                 ),
+                # in-flight + recent request traces: the serve-side
+                # post-mortem payload (which streams were open, how far
+                # each had gotten) — see docs/observability.md
+                "request_traces": self.requests.snapshot(),
             }
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
@@ -115,6 +134,27 @@ class FlightRecorder:
             (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
 
         sys.excepthook = _hook
+
+        # sys.excepthook never sees worker-thread deaths; the serving
+        # engine re-raises on its own thread ON PURPOSE (loud death over
+        # silent hang), so a serving crash must trigger through here
+        self._prev_thread_hook = threading.excepthook
+
+        def _thread_hook(args):
+            if args.exc_type is not SystemExit:
+                name = getattr(args.thread, "name", "?")
+                self.dump(
+                    f"thread-exception-{name}",
+                    detail="".join(
+                        traceback.format_exception(
+                            args.exc_type, args.exc_value, args.exc_traceback
+                        )
+                    )[-4000:],
+                )
+            prev = self._prev_thread_hook or threading.__excepthook__
+            prev(args)
+
+        threading.excepthook = _thread_hook
 
         if sigterm:
             try:

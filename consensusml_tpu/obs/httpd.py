@@ -1,0 +1,105 @@
+"""Live ``/metrics`` endpoint: scrape the registry over HTTP.
+
+The textfile collector is pull-at-cadence — the file is only as fresh as
+the last ``--telemetry-every`` rewrite, and a serving process with no
+training loop has no natural rewrite cadence at all. This stdlib
+``ThreadingHTTPServer`` serves the SAME locked ``expose()`` path the
+textfile writer uses, freshly rendered per GET, so a Prometheus scraper
+(or a human with curl) sees live values:
+
+- ``GET /metrics``  — Prometheus text exposition (``to_prometheus()``);
+- ``GET /traces``   — the merged Chrome trace JSON (span ring + request
+  lanes, Perfetto-loadable — the live twin of ``--trace-events``);
+- ``GET /requests`` — the request-trace registry snapshot JSON
+  (in-flight + recent completed, docs/observability.md "Request
+  tracing").
+
+Surfaces: ``train.py --metrics-port N`` and
+``ServeServer(metrics_port=N)`` (``0`` picks a free port; read it back
+from :attr:`MetricsServer.port`). Render cost is paid by the scraper's
+thread — the train/serve hot paths only ever touch the per-metric locks
+they already hold for a few µs per update.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry
+from consensusml_tpu.obs.requests import (
+    RequestTraceRegistry,
+    get_request_registry,
+    merged_chrome_trace,
+)
+from consensusml_tpu.obs.tracer import SpanTracer, get_tracer
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Threaded HTTP exporter over the process's observability state."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: MetricsRegistry | None = None,
+        tracer: SpanTracer | None = None,
+        requests: RequestTraceRegistry | None = None,
+    ):
+        registry = registry if registry is not None else get_registry()
+        tracer = tracer if tracer is not None else get_tracer()
+        requests = requests if requests is not None else get_request_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API name
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = registry.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/traces":
+                    body = json.dumps(
+                        merged_chrome_trace(tracer, requests)
+                    ).encode()
+                    ctype = "application/json"
+                elif path == "/requests":
+                    body = json.dumps(requests.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "try /metrics, /traces, /requests")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass  # scrapes are not log lines
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.address: tuple[str, int] = self._httpd.server_address[:2]
+        self.port: int = self.address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="obs-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.address[0]}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -217,7 +217,10 @@ class Gauge(_Metric):
             return self._value
 
 
-@guarded_by("_lock", "_counts", "_sum", "_count")
+EXEMPLAR_KEEP = 8  # worst observations retained per histogram
+
+
+@guarded_by("_lock", "_counts", "_sum", "_count", "_exemplars")
 class Histogram(_Metric):
     """Fixed-bucket cumulative histogram (Prometheus ``histogram``).
 
@@ -227,6 +230,15 @@ class Histogram(_Metric):
     observers hold: an unlocked export could emit a cumulative bucket
     row that disagrees with ``_sum`` (torn between two observes), which
     Prometheus rate math turns into negative latencies.
+
+    ``observe(v, exemplar="req-...")`` makes the histogram
+    EXEMPLAR-BEARING: the ``EXEMPLAR_KEEP`` worst (largest) exemplared
+    observations are retained with their ids, so a p99 spike in an SLO
+    family resolves to the concrete request ids that caused it (the
+    ``value_dict``/snapshot side carries them; the Prometheus text
+    exposition stays plain-format — exemplars are an OpenMetrics
+    extension the textfile collector does not parse). Semantics in
+    docs/observability.md "Request tracing".
     """
 
     kind = "histogram"
@@ -244,14 +256,30 @@ class Histogram(_Metric):
         self._counts = [0] * (len(bs) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._count = 0
+        # (value, exemplar_id, unix_time) sorted worst-first, len<=KEEP
+        self._exemplars: list[tuple[float, str, float]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                ex = self._exemplars
+                if len(ex) < EXEMPLAR_KEEP or v > ex[-1][0]:
+                    ex.append((v, str(exemplar), time.time()))
+                    ex.sort(key=lambda t: -t[0])
+                    del ex[EXEMPLAR_KEEP:]
+
+    def exemplars(self) -> list[dict[str, Any]]:
+        """Worst-first retained exemplars (``value``/``id``/``time_s``)."""
+        with self._lock:
+            ex = list(self._exemplars)
+        return [
+            {"value": v, "id": rid, "time_s": ts} for v, rid, ts in ex
+        ]
 
     @property
     def count(self) -> int:
@@ -284,7 +312,7 @@ class Histogram(_Metric):
 
     def value_dict(self) -> dict[str, Any]:
         counts, total, n = self._snapshot()
-        return {
+        out = {
             "count": n,
             "sum": total,
             "buckets": {
@@ -292,6 +320,10 @@ class Histogram(_Metric):
             },
             "inf": counts[-1],
         }
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = ex
+        return out
 
 
 def _fmt(v: float) -> str:

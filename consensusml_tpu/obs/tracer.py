@@ -102,6 +102,31 @@ class SpanTracer:
             with self._lock:
                 self._events.append(ev)
 
+    def complete(self, name: str, dur_s: float, **attrs) -> None:
+        """Append an externally-timed completed span ending NOW.
+
+        The train loop measures some phases itself (the feed stall is
+        clocked inside the prefetcher's queue pop, the fence wait inside
+        the round timer) — this records them as first-class spans so the
+        per-round phase rows (``round.feed`` / ``round.fence``) ride the
+        same ring, digest, and Chrome export as ``with``-recorded spans.
+        """
+        if not self.enabled:
+            return
+        dur = max(float(dur_s), 0.0)
+        end = time.perf_counter() - self._anchor_perf
+        ev = {
+            "name": name,
+            "ts_us": (end - dur) * 1e6,
+            "dur_us": dur * 1e6,
+            "tid": threading.get_ident(),
+            "depth": getattr(self._tls, "depth", 0),
+        }
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._events.append(ev)
+
     def instant(self, name: str, **attrs) -> None:
         """Zero-duration marker event (watchdog beats, fault rounds)."""
         if not self.enabled:
@@ -157,6 +182,54 @@ class SpanTracer:
                 rec["args"] = ev["args"]
             out.append(rec)
         return out
+
+    def digest(self, max_rounds: int = 64) -> dict[str, Any]:
+        """Compact summary of the ring for cluster snapshots.
+
+        Two parts (docs/observability.md "Cross-rank round timeline"):
+
+        - ``spans`` — per-name count/total/max, the whole ring;
+        - ``rounds`` — one row per round index found in span attrs
+          (``round=`` is stamped by the train loop on ``train.round``
+          and the synthetic ``round.feed`` / ``round.fence`` phase
+          spans), last ``max_rounds`` rows. The aggregator merges these
+          across ranks into the round timeline that attributes a
+          straggler round to its phase.
+
+        A few hundred bytes per rank per snapshot — cheap enough to ride
+        every :class:`~consensusml_tpu.obs.cluster.ClusterWriter` write.
+        """
+        names: dict[str, dict[str, float]] = {}
+        rounds: dict[int, dict[str, Any]] = {}
+        per_round_key = {
+            "train.round": "dur_us",
+            "round.feed": "feed_us",
+            "round.fence": "fence_us",
+        }
+        for ev in self.events():
+            d = names.setdefault(
+                ev["name"], {"count": 0, "total_us": 0.0, "max_us": 0.0}
+            )
+            d["count"] += 1
+            d["total_us"] += ev["dur_us"]
+            d["max_us"] = max(d["max_us"], ev["dur_us"])
+            rnd = (ev.get("args") or {}).get("round")
+            key = per_round_key.get(ev["name"])
+            if key is not None and isinstance(rnd, (int, float)):
+                row = rounds.setdefault(int(rnd), {"round": int(rnd)})
+                row[key] = round(ev["dur_us"], 1)
+        return {
+            "anchor_epoch_s": self._anchor_epoch,
+            "spans": {
+                k: {
+                    "count": int(v["count"]),
+                    "total_us": round(v["total_us"], 1),
+                    "max_us": round(v["max_us"], 1),
+                }
+                for k, v in sorted(names.items())
+            },
+            "rounds": [rounds[r] for r in sorted(rounds)][-max_rounds:],
+        }
 
     def write_chrome_trace(self, path: str) -> str:
         """Dump the ring as a Perfetto-loadable trace-event JSON file."""

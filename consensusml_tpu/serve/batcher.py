@@ -34,6 +34,10 @@ class GenResult:
     latency_s: float  # arrival -> completion
     prompt_len: int
     generation: int = 0  # artifact generation that finished the stream
+    # request-scoped tracing identity (obs/requests.py): clients join
+    # their own observations to the server's trace on these
+    trace_id: str = ""
+    request_id: str = ""
 
 
 class RequestHandle:
@@ -83,6 +87,9 @@ class Request:
     max_new_tokens: int
     handle: RequestHandle
     arrival_t: float = dataclasses.field(default_factory=time.perf_counter)
+    # TraceContext (obs/requests.py); the engine mints one when the
+    # client didn't send one, so ctx is always set post-submit
+    ctx: Any = None
 
 
 @dataclasses.dataclass

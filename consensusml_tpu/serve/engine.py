@@ -154,7 +154,13 @@ class Engine:
         self._draining = threading.Event()
         self._drained = threading.Event()
 
+        from consensusml_tpu.obs import get_request_registry
+
         self._tracer = get_tracer()
+        # request-scoped traces: every request's submit → admission →
+        # prefill → decode → completion story (obs/requests.py; the
+        # flight recorder dumps this registry on a serving crash)
+        self._rt = get_request_registry()
         reg = get_registry()
         self._m_requests = reg.counter(
             "consensusml_serve_requests_total", "requests accepted by submit()"
@@ -251,8 +257,15 @@ class Engine:
         *,
         block: bool = True,
         timeout: float | None = None,
+        trace: Any = None,
     ):
         """Enqueue one request; returns a ``RequestHandle``.
+
+        ``trace`` is an optional :class:`~consensusml_tpu.obs.
+        TraceContext` the client minted (loadgen / the line-JSON
+        protocol); without one the engine mints its own, so EVERY
+        accepted request has a recorded trace (docs/observability.md
+        "Request tracing").
 
         Raises ``queue.Full`` when the bounded queue is full (with
         ``block=False`` or after ``timeout``) and ``RuntimeError`` once
@@ -280,12 +293,19 @@ class Engine:
                 f"the cache length {self.max_len}; shorten one or build the "
                 "engine with a larger ServeConfig.max_len"
             )
+        from consensusml_tpu.obs import TraceContext
+
+        ctx = trace if trace is not None else TraceContext.mint("srv")
         handle = self._RequestHandle(len(ids))
-        req = self._Request(list(map(int, ids)), max_new, handle)
+        req = self._Request(list(map(int, ids)), max_new, handle, ctx=ctx)
+        self._rt.start(
+            ctx, len(ids), max_new_tokens=max_new, generation=self._generation
+        )
         try:
             self._queue.put(req, block=block, timeout=timeout)
         except queue.Full:
             self._m_rejected.inc()
+            self._rt.finish(ctx.request_id, "rejected", detail="queue_full")
             raise
         if self._drained.is_set():
             # lost the race against loop exit: the put landed after the
@@ -401,6 +421,11 @@ class Engine:
         self._generation = sw.generation
         for _i, slot in self._table.active:
             slot.generation = sw.generation
+            # a mid-stream generation flip is part of the request's
+            # story: prefix decoded under g, suffix under g+1
+            self._rt.event(
+                self._rid(slot.request), "hotswap", generation=sw.generation
+            )
         self._swaps += 1
         self._m_swaps.inc()
         self._m_generation.set(sw.generation)
@@ -574,13 +599,28 @@ class Engine:
                 )
                 # defer (don't drop) when this tick's prefill budget is
                 # spent or the pool can't hold the prompt yet; the
-                # request keeps its place at the head of the line
-                if not self._pool.can_admit(need) or not self._sched.try_admit(
-                    bucket
-                ):
+                # request keeps its place at the head of the line —
+                # every deferred tick lands on the request's trace, so
+                # a long admission wait is attributable, not invisible
+                if not self._pool.can_admit(need):
+                    self._rt.event(
+                        self._rid(req), "admission.defer", reason="blocks"
+                    )
+                    self._requeue.appendleft(req)
+                    return
+                if not self._sched.try_admit(bucket):
+                    self._rt.event(
+                        self._rid(req), "admission.defer", reason="budget"
+                    )
                     self._requeue.appendleft(req)
                     return
             self._admit(req)
+
+    @staticmethod
+    def _rid(req) -> str | None:
+        """The request's trace id, when it carries one (requests built
+        outside submit() — direct Request() in tests — may not)."""
+        return getattr(req.ctx, "request_id", None)
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -614,6 +654,10 @@ class Engine:
         already = len(req.handle._all)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.ids
+        self._rt.event(
+            self._rid(req), "admission", slot=idx, bucket=bucket,
+            continuation=bool(already),
+        )
         t0 = time.perf_counter()
         with self._tracer.span("serve.prefill", bucket=bucket, slot=idx):
             if self.paged:
@@ -643,10 +687,14 @@ class Engine:
                 )
             tok = int(tok_dev)  # device fence: the first token is real now
         now = time.perf_counter()
-        self._m_prefill.observe(now - t0)
+        rid = self._rid(req)
+        self._m_prefill.observe(now - t0, exemplar=rid)
+        self._rt.event(
+            rid, "prefill", bucket=bucket, seconds=round(now - t0, 6)
+        )
         ttft = now - req.arrival_t
         if already == 0:
-            self._m_ttft.observe(ttft)
+            self._m_ttft.observe(ttft, exemplar=rid)
             self._ttfts.append(ttft)
             req.handle._ttft_s = ttft
         else:  # continuation: the stream's real TTFT already happened
@@ -688,6 +736,10 @@ class Engine:
         # are always the original prompt
         req.ids = list(req.ids[: req.handle.prompt_len]) + list(
             req.handle._all
+        )
+        self._rt.event(
+            self._rid(req), "preempt", reason="blocks_exhausted",
+            generated=len(req.handle._all),
         )
         # head of the line, AHEAD of any budget-deferred fresh arrival
         # (its tokens are already streaming to a client; a fresh request
@@ -751,7 +803,14 @@ class Engine:
             next_toks = np.asarray(next_dev)  # device fence per step
         dt = time.perf_counter() - t0
         now = time.perf_counter()
-        self._m_intertoken.observe(dt)
+        # exemplar: the oldest resident stream — the one that has been
+        # paying this step time the longest — stands in for the batch
+        self._m_intertoken.observe(
+            dt,
+            exemplar=self._rid(
+                min(active, key=lambda t: t[1].request.arrival_t)[1].request
+            ),
+        )
         self._step_times.append(dt)
         self._decode_time_s += dt
         self._decode_steps += 1
@@ -764,6 +823,10 @@ class Engine:
             self._block_occupancy_sum += occ
             self._m_block_occ.set(occ)
             self._m_blocks_free.set(self._pool.free_blocks)
+        # one lock round-trip covers every resident slot's tick
+        self._rt.decode_ticks(
+            [self._rid(slot.request) for _i, slot in active]
+        )
         for i, slot in active:
             tok = int(next_toks[i])
             slot.request.handle._emit(tok)
@@ -796,17 +859,26 @@ class Engine:
         from consensusml_tpu.serve.batcher import GenResult
 
         now = time.perf_counter()
+        latency = now - req.arrival_t
+        ctx = req.ctx
         req.handle._finish(
             GenResult(
                 tokens=list(tokens),
                 finish_reason=reason,
                 ttft_s=ttft,
-                latency_s=now - req.arrival_t,
+                latency_s=latency,
                 prompt_len=req.handle.prompt_len,
                 generation=(
                     self._generation if generation is None else generation
                 ),
+                trace_id=getattr(ctx, "trace_id", ""),
+                request_id=getattr(ctx, "request_id", ""),
             )
+        )
+        self._rt.finish(
+            self._rid(req), reason,
+            tokens=len(tokens), ttft_s=round(ttft, 6),
+            latency_s=round(latency, 6),
         )
         if reason != "cancelled":
             self._m_completed.inc()

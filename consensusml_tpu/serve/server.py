@@ -3,16 +3,20 @@
 Line-delimited JSON over TCP — deliberately minimal (no HTTP dependency
 in this environment) but shaped like a real serving front-end:
 
-request (one line)::
+request (one line; ``trace_id``/``request_id`` are optional — anything
+missing is minted server-side, so every request is traceable)::
 
-    {"ids": [3, 17, 42], "max_new_tokens": 16}
+    {"ids": [3, 17, 42], "max_new_tokens": 16,
+     "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
-response (streamed, one line per token, then a terminal record)::
+response (streamed, one line per token, then a terminal record echoing
+the trace identity so client and server observations join on it)::
 
     {"token": 7}
     {"token": 19}
     {"done": true, "tokens": [7, 19, ...], "finish_reason": "max_tokens",
-     "ttft_ms": 12.3, "latency_ms": 48.9}
+     "ttft_ms": 12.3, "latency_ms": 48.9,
+     "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
 errors land as ``{"error": "..."}`` and close the connection. One
 request per connection keeps the protocol trivially load-generatable
@@ -38,14 +42,40 @@ __all__ = ["ServeServer"]
 
 class ServeServer:
     """Accept loop + one thread per connection; ``port=0`` picks a free
-    port (read it back from :attr:`address`)."""
+    port (read it back from :attr:`address`).
 
-    def __init__(self, engine: Any, host: str = "127.0.0.1", port: int = 0):
+    ``metrics_port`` (``None`` = off, ``0`` = auto) additionally serves
+    the live observability endpoints — ``/metrics`` Prometheus text,
+    ``/traces`` merged Chrome trace, ``/requests`` request-trace
+    snapshot — from :class:`consensusml_tpu.obs.MetricsServer`; read the
+    bound address back from :attr:`metrics_address`.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_port: int | None = None,
+    ):
         self.engine = engine
+        self.metrics = None
+        self.metrics_address = None
+        # bind the front-end listener FIRST: if the port is taken, the
+        # constructor raises before any side server thread exists
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
+        if metrics_port is not None:
+            from consensusml_tpu.obs import MetricsServer
+
+            try:
+                self.metrics = MetricsServer(port=metrics_port, host=host)
+            except OSError:
+                self._sock.close()
+                raise
+            self.metrics_address = self.metrics.address
         self._sock.settimeout(0.2)  # accept loop polls the stop flag
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
@@ -79,8 +109,15 @@ class ServeServer:
                     return
                 try:
                     req = json.loads(line)
+                    trace = None
+                    if req.get("trace_id"):
+                        from consensusml_tpu.obs import TraceContext
+
+                        trace = TraceContext(
+                            req["trace_id"], req.get("request_id")
+                        )
                     handle = self.engine.submit(
-                        req["ids"], req.get("max_new_tokens")
+                        req["ids"], req.get("max_new_tokens"), trace=trace
                     )
                 except Exception as e:  # bad JSON, validation, draining
                     f.write(json.dumps({"error": str(e)}).encode() + b"\n")
@@ -98,6 +135,8 @@ class ServeServer:
                             "finish_reason": r.finish_reason,
                             "ttft_ms": round(1e3 * r.ttft_s, 3),
                             "latency_ms": round(1e3 * r.latency_s, 3),
+                            "trace_id": r.trace_id,
+                            "request_id": r.request_id,
                         }
                     ).encode()
                     + b"\n"
@@ -124,3 +163,5 @@ class ServeServer:
         for t in list(self._conns):  # let response streams flush
             t.join(timeout=2.0)
         self._thread.join(timeout=2.0)
+        if self.metrics is not None:
+            self.metrics.close()

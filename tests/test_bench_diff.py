@@ -1,0 +1,107 @@
+"""tools/bench_diff.py — the bench regression sentinel (ISSUE 10).
+
+Schema-smoke in tier-1 so the tool can't rot: it must run CLEAN against
+the checked-in BENCH_r0*.json trajectory, fail loudly on a synthetic
+regression and on a blown absolute budget, and its built-in spec must
+stay well-formed.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "tools", "bench_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_spec_is_well_formed():
+    mod = _tool()
+    assert mod.DEFAULT_SPEC
+    for entry in mod.DEFAULT_SPEC:
+        assert entry["direction"] in ("up", "down", "max")
+        if entry["direction"] == "max":
+            assert "bound" in entry
+        else:
+            assert entry.get("tol_pct", 0) >= 0
+    # the documented observability budgets are enforced as absolutes
+    keys = {e["key"] for e in mod.DEFAULT_SPEC}
+    assert "observability.link_probe_overhead_pct" in keys
+    assert "observability.request_tracing_overhead_pct" in keys
+
+
+def test_runs_clean_against_checked_in_trajectory(capsys):
+    """The acceptance check: the archive agrees with itself — the newest
+    trajectory point diffed against the trajectory is not a regression."""
+    mod = _tool()
+    rc = mod.main([os.path.join(REPO, "BENCH_r05.json")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "bench-diff PASSED" in out
+    assert "regression" not in out.split("bench-diff")[0]
+
+
+def test_regression_and_budget_violations_exit_nonzero(tmp_path, capsys):
+    mod = _tool()
+    fresh = {
+        "parsed": {
+            "value": 1000.0,  # ~60% below the trajectory's 2554
+            "vs_baseline": 0.4,
+        },
+        # blown absolute budget (docs promise <1%)
+        "observability": {"request_tracing_overhead_pct": 2.5},
+    }
+    path = tmp_path / "fresh.json"
+    path.write_text(json.dumps(fresh))
+    rc = mod.main([str(path), "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "value" in failed
+    assert "observability.request_tracing_overhead_pct" in failed
+    assert doc["counts"]["regressions"] >= 3
+
+
+def test_direction_semantics_up_down_and_tolerance():
+    mod = _tool()
+    ref = {"value": 100.0, "serving": {"ttft_p99_ms": 50.0}}
+    spec = [
+        {"key": "value", "direction": "up", "tol_pct": 10.0},
+        {"key": "serving.ttft_p99_ms", "direction": "down", "tol_pct": 20.0},
+    ]
+    ok = mod.diff({"value": 91.0, "serving": {"ttft_p99_ms": 59.0}}, ref, spec)
+    assert ok["ok"] and ok["counts"]["checked"] == 2
+    worse = mod.diff(
+        {"value": 89.0, "serving": {"ttft_p99_ms": 61.0}}, ref, spec
+    )
+    assert not worse["ok"]
+    assert [r["status"] for r in worse["rows"]] == ["regression"] * 2
+
+
+def test_missing_metrics_are_skipped_not_failed(capsys):
+    mod = _tool()
+    report = mod.diff({"value": 2554.1}, {"value": 2554.1}, mod.DEFAULT_SPEC)
+    assert report["ok"]
+    assert report["counts"]["skipped"] > 0
+    for row in report["rows"]:
+        if row["status"] == "skipped":
+            assert "why" in row
+
+
+def test_unreadable_inputs_exit_2(tmp_path, capsys):
+    mod = _tool()
+    assert mod.main([str(tmp_path / "nope.json")]) == 2
+    assert "cannot read" in capsys.readouterr().err
+    bad = tmp_path / "fresh.json"
+    bad.write_text("{}")
+    empty = tmp_path / "emptyrepo"
+    empty.mkdir()
+    assert mod.main([str(bad), "--repo-root", str(empty)]) == 2
+    assert "no trajectory" in capsys.readouterr().err

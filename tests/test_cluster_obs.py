@@ -348,7 +348,7 @@ def test_loadgen_metrics_merge_with_rank_snapshots(tmp_path):
     lg = _tool("loadgen")
     from consensusml_tpu.obs import get_registry
 
-    def submit(ids, max_new):
+    def submit(ids, max_new, ctx):
         return {"ttft_s": 0.01, "latency_s": 0.05, "tokens": [1] * max_new}
 
     report = lg.run_loadgen(
@@ -369,6 +369,111 @@ def test_loadgen_metrics_merge_with_rank_snapshots(tmp_path):
     assert ttft["count"] >= 4 and math.isfinite(ttft["p99"])
     # the rank rows are unaffected by the client snapshot
     assert len(doc["ranks"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# round timeline + slowest-request table: two ranks + a loadgen client
+# ---------------------------------------------------------------------------
+
+
+def _write_rank_with_digest(
+    tmp_path, rank, *, rounds, lat_s, feed_s, now
+):
+    """A rank snapshot whose span digest carries per-round phase rows
+    (train.round + round.feed/round.fence) and a compile-phase ratio
+    (gossip.round vs train.inner_loop at 3:1)."""
+    reg = MetricsRegistry()
+    reg.counter("consensusml_rounds_total").inc(rounds)
+    tracer = SpanTracer()
+    tracer.complete("gossip.round", 0.03)
+    tracer.complete("train.inner_loop", 0.01)
+    for r in range(rounds):
+        tracer.complete("round.feed", feed_s, round=r)
+        tracer.complete("round.fence", lat_s / 2, round=r)
+        tracer.complete("train.round", lat_s, round=r)
+    ClusterWriter(
+        str(tmp_path), rank=rank, registry=reg, world_size=2, tracer=tracer
+    ).write(round=rounds - 1)
+
+
+def test_round_timeline_and_request_table_merge_deterministically(tmp_path):
+    """The ISSUE-10 cluster fixture: two ranks with span digests (rank 1
+    is the straggler, its extra time dominated by feed stall) plus a
+    loadgen client snapshot carrying exemplar-bearing SLOs and the
+    request-trace dump — one deterministic merged report with the
+    cross-rank round timeline and the slowest-request table."""
+    from consensusml_tpu.obs import RequestTraceRegistry, TraceContext
+    from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+
+    now = time.time()
+    _write_rank_with_digest(
+        tmp_path, 0, rounds=3, lat_s=0.10, feed_s=0.001, now=now
+    )
+    _write_rank_with_digest(
+        tmp_path, 1, rounds=3, lat_s=0.30, feed_s=0.180, now=now
+    )
+
+    # loadgen client: two traced requests, the slow one exemplared
+    reg = MetricsRegistry()
+    rt = RequestTraceRegistry()
+    for rid, ttft in (("lgf-00000", 0.004), ("lgf-00001", 0.212)):
+        ctx = TraceContext(rid)
+        rt.start(ctx, 4)
+        rt.event(ctx.request_id, "admission", slot=0, bucket=8)
+        rt.event(ctx.request_id, "prefill", bucket=8)
+        rt.decode_tick(ctx.request_id)
+        rt.finish(ctx.request_id, "max_tokens", tokens=3)
+        reg.histogram(
+            "consensusml_loadgen_ttft_seconds", buckets=DEFAULT_SLO_BUCKETS
+        ).observe(ttft, exemplar=ctx.request_id)
+    ClusterWriter(
+        str(tmp_path), rank=0, role="loadgen", registry=reg
+    ).write(extra={"request_traces": rt.snapshot()})
+
+    doc = aggregate(str(tmp_path), now=now)
+
+    # ---- round timeline: 3 rounds, rank 1 the feed-bound straggler ------
+    timeline = doc["round_timeline"]
+    assert [row["round"] for row in timeline] == [0, 1, 2]
+    for row in timeline:
+        assert [r["rank"] for r in row["ranks"]] == [0, 1]
+        st = row["straggler"]
+        assert st["rank"] == 1
+        assert st["extra_ms"] == pytest.approx(200.0, abs=1.0)
+        assert st["phase"] == "feed"
+        assert st["feed_ms"] == pytest.approx(179.0, abs=1.0)
+        # the non-feed remainder splits 3:1 gossip:compute (the digest's
+        # compile-round ratio), marked as an estimate
+        assert st["gossip_ms_est"] == pytest.approx(
+            0.75 * (st["extra_ms"] - st["feed_ms"]), rel=1e-6
+        )
+
+    # ---- slowest-request table: exemplar resolves to the trace ----------
+    req = doc["requests"]
+    assert req["traces_indexed"] == 2 and req["in_flight"] == 0
+    (top, second) = req["slowest"]
+    assert top["metric"] == "consensusml_loadgen_ttft_seconds"
+    assert top["side"] == "client"
+    assert top["request_id"] == "lgf-00001/0"
+    assert top["resolved"] and top["trace_id"] == "lgf-00001"
+    assert top["trace"]["decode_ticks"] == 1
+    assert "prefill" in top["trace"]["events"]
+    assert second["request_id"] == "lgf-00000/0"
+
+    # ---- deterministic merge + rendered report --------------------------
+    assert aggregate(str(tmp_path), now=now) == doc
+    mod = _tool("obs_report")
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert mod.main([str(tmp_path)]) == 0
+    out = buf.getvalue()
+    assert "slowest requests (SLO exemplars -> traces):" in out
+    assert "lgf-00001/0" in out
+    assert "round timeline (cross-rank, straggler time by phase):" in out
+    assert "-> feed" in out
 
 
 # ---------------------------------------------------------------------------

@@ -521,6 +521,82 @@ def test_finding_id_is_line_number_stable():
 
 
 # ---------------------------------------------------------------------------
+# docs-drift pass: code families vs docs/observability.md
+# ---------------------------------------------------------------------------
+
+
+def _drift(tmp_path, code: str, doc: str):
+    from consensusml_tpu.analysis import docs_drift
+
+    src = tmp_path / "mod.py"
+    src.write_text(textwrap.dedent(code))
+    docp = tmp_path / "observability.md"
+    docp.write_text(textwrap.dedent(doc))
+    return docs_drift.run(
+        str(tmp_path), py_files=[str(src)], doc_path=str(docp)
+    )
+
+
+def test_docs_drift_undocumented_metric_is_flagged(tmp_path):
+    fs = _drift(
+        tmp_path,
+        """
+        def f(reg):
+            reg.counter("consensusml_widget_total", "widgets")
+            reg.gauge("consensusml_depth", "documented one")
+        """,
+        "| `consensusml_depth` | gauge | documented |\n",
+    )
+    assert _rules(fs) == ["undocumented-metric"]
+    (f,) = fs
+    assert f.detail == "consensusml_widget_total" and f.symbol == "f"
+
+
+def test_docs_drift_stale_doc_entry_is_flagged(tmp_path):
+    fs = _drift(
+        tmp_path,
+        """
+        def f(reg):
+            reg.counter("consensusml_widget_total")
+        """,
+        "`consensusml_widget_total` and `consensusml_gone_total`\n",
+    )
+    assert _rules(fs) == ["stale-doc-metric"]
+    assert fs[0].detail == "consensusml_gone_total"
+
+
+def test_docs_drift_dynamic_prefix_exempts_doc_entries(tmp_path):
+    # f-string-composed families: the literal prefix marks the namespace
+    # as dynamically emitted, so doc rows under it are not stale — but
+    # the bare consensusml_ prefix must NOT blanket-exempt everything
+    fs = _drift(
+        tmp_path,
+        """
+        def f(reg, kind):
+            reg.counter(f"consensusml_swarm_{kind}_total")
+        """,
+        "`consensusml_swarm_join_total` but also `consensusml_vanished`\n",
+    )
+    assert _rules(fs) == ["stale-doc-metric"]
+    assert fs[0].detail == "consensusml_vanished"
+
+
+def test_docs_drift_repo_is_clean():
+    """The repo's metric schema agrees with docs/observability.md —
+    modulo the baselined dynamically-composed families (engine
+    telemetry gauges, MetricsLogger per-field gauges)."""
+    from consensusml_tpu.analysis import docs_drift
+
+    findings = docs_drift.check_repo(REPO)
+    baseline = load_baseline(os.path.join(REPO, ".cml-check-baseline"))
+    active, suppressed, _stale = split_suppressed(findings, baseline)
+    assert active == []
+    # every suppression is a stale-doc entry for a dynamic family, never
+    # an undocumented emission
+    assert all(f.rule == "stale-doc-metric" for f in suppressed)
+
+
+# ---------------------------------------------------------------------------
 # the CLI gate (acceptance criteria)
 # ---------------------------------------------------------------------------
 
@@ -544,7 +620,9 @@ def test_cli_all_exits_zero_on_repo():
     assert doc["findings"] == []
     assert doc["counts"]["suppressed"] >= 1  # the intentional-sync inventory
     assert doc["counts"]["stale"] == 0, doc["stale_baseline"]
-    assert set(doc["passes"]) == {"host-sync", "locks", "schedule", "jaxpr"}
+    assert set(doc["passes"]) == {
+        "host-sync", "locks", "docs-drift", "schedule", "jaxpr"
+    }
 
 
 def test_cli_path_restricted_run_does_not_report_foreign_stale(tmp_path):

@@ -1,0 +1,487 @@
+"""Request-scoped tracing plane (ISSUE 10): TraceContext propagation,
+the bounded RequestTrace registry, exemplar-bearing SLO histograms, the
+live /metrics endpoint, and the serving-crash flight-recorder dump.
+
+Acceptance anchors: a loadgen → ServeServer → Engine round-trip where
+every completed request's trace carries submit → admission → prefill →
+decode → completion (plus preemption and hot-swap events when induced),
+and a p99 exemplar request_id that resolves to a real recorded trace on
+both the client and server snapshots. All tier-1 fast.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusml_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    RequestTraceRegistry,
+    SpanTracer,
+    TraceContext,
+    get_request_registry,
+    merged_chrome_trace,
+)
+from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.serving]
+
+
+def _tiny_gpt2(max_len=32):
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=max_len,
+            dropout=0.0,
+        )
+    )
+
+
+def _init(model, seq=8, seed=0):
+    return model.init(
+        jax.random.key(seed), jnp.zeros((1, seq), jnp.int32)
+    )["params"]
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_mint_and_explicit():
+    a, b = TraceContext.mint("x"), TraceContext.mint("x")
+    assert a.trace_id != b.trace_id
+    assert a.request_id == a.trace_id + "/0"
+    c = TraceContext("tid-1", "tid-1/7")
+    assert (c.trace_id, c.request_id) == ("tid-1", "tid-1/7")
+    assert TraceContext("tid-2").request_id == "tid-2/0"
+
+
+def test_registry_records_stage_events_and_tick_counts():
+    reg = RequestTraceRegistry()
+    ctx = TraceContext("t1")
+    reg.start(ctx, prompt_len=5, max_new_tokens=4)
+    reg.event(ctx.request_id, "admission.defer", reason="budget")
+    reg.event(ctx.request_id, "admission", slot=2, bucket=8)
+    reg.event(ctx.request_id, "prefill", bucket=8, seconds=0.01)
+    for _ in range(3):
+        reg.decode_tick(ctx.request_id)
+    reg.event(ctx.request_id, "hotswap", generation=4)
+    reg.finish(ctx.request_id, "max_tokens", tokens=4)
+    tr = reg.get(ctx.request_id)
+    assert tr.finish_reason == "max_tokens"
+    assert tr.decode_ticks == 3 and tr.defer_ticks == 1
+    assert tr.generation == 4
+    d = tr.to_dict()
+    assert [e["name"] for e in d["events"]] == [
+        "submit", "admission.defer", "admission", "prefill", "decode",
+        "hotswap", "complete",
+    ]
+    # timestamps are monotone within the trace
+    ts = [e["ts_us"] for e in d["events"]]
+    assert ts == sorted(ts)
+    # unknown / finished ids are no-ops, never raises
+    reg.event("nope", "admission")
+    reg.decode_tick(ctx.request_id)
+    assert reg.get(ctx.request_id).decode_ticks == 3
+
+
+def test_registry_is_bounded_both_ways():
+    reg = RequestTraceRegistry(capacity=4, max_active=3)
+    for i in range(6):
+        reg.start(TraceContext(f"t{i}"), 1)
+    assert reg.active_count() == 3  # oldest force-completed
+    snap = reg.snapshot()
+    assert len(snap["completed"]) <= 4
+    truncated = [t for t in snap["completed"] if t["finish_reason"] == "truncated"]
+    assert truncated, "evicted in-flight traces must be marked truncated"
+    for i in range(6):
+        reg.finish(f"t{i}/0", "done")
+    assert reg.active_count() == 0
+    assert len(reg.snapshot()["completed"]) == 4  # ring bound
+
+
+def test_snapshot_carries_in_flight_traces():
+    reg = RequestTraceRegistry()
+    reg.start(TraceContext("open"), 3)
+    snap = reg.snapshot()
+    (active,) = snap["active"]
+    assert active["request_id"] == "open/0"
+    assert active["finish_reason"] is None
+    json.dumps(snap)  # JSON-able as-is
+
+
+def test_merged_chrome_trace_has_span_and_request_lanes():
+    tracer = SpanTracer()
+    reg = RequestTraceRegistry()
+    with tracer.span("serve.decode_step", active=1):
+        pass
+    ctx = TraceContext("tr")
+    reg.start(ctx, 2)
+    reg.finish(ctx.request_id, "max_tokens")
+    doc = merged_chrome_trace(tracer, reg)
+    names = [e.get("name") for e in doc["traceEvents"]]
+    assert "serve.decode_step" in names
+    assert "request" in names and "req.submit" in names
+    req = next(e for e in doc["traceEvents"] if e.get("name") == "request")
+    assert req["ph"] == "X" and req["args"]["trace_id"] == "tr"
+
+
+# ---------------------------------------------------------------------------
+# exemplar-bearing histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_retains_worst_exemplars():
+    r = MetricsRegistry()
+    h = r.histogram("t_slo_seconds", buckets=DEFAULT_SLO_BUCKETS)
+    for i in range(50):
+        h.observe(0.001 * (i + 1), exemplar=f"req-{i}")
+    h.observe(0.9)  # un-exemplared observations never displace ids
+    ex = h.exemplars()
+    assert len(ex) == 8
+    assert ex[0]["id"] == "req-49" and ex[0]["value"] == pytest.approx(0.050)
+    assert [e["value"] for e in ex] == sorted(
+        (e["value"] for e in ex), reverse=True
+    )
+    vd = h.value_dict()
+    assert vd["exemplars"][0]["id"] == "req-49"
+    # exposition stays plain prometheus text (no OpenMetrics extension)
+    assert "req-49" not in r.to_prometheus()
+
+
+def test_cluster_merge_keeps_worst_exemplars():
+    from consensusml_tpu.obs.cluster import _merge_hist
+
+    a = MetricsRegistry().histogram("m", buckets=(0.1, 1.0))
+    b = MetricsRegistry().histogram("m", buckets=(0.1, 1.0))
+    a.observe(0.5, exemplar="a-slow")
+    b.observe(2.0, exemplar="b-slower")
+    merged = _merge_hist(a.value_dict(), b.value_dict())
+    assert merged["count"] == 2
+    assert merged["exemplars"][0]["id"] == "b-slower"
+    assert merged["exemplars"][1]["id"] == "a-slow"
+
+
+# ---------------------------------------------------------------------------
+# live /metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_serves_live_registry_traces_and_requests():
+    reg = MetricsRegistry()
+    tracer = SpanTracer()
+    rt = RequestTraceRegistry()
+    reg.counter("t_live_total").inc(3)
+    ctx = TraceContext("live")
+    rt.start(ctx, 2)
+    with MetricsServer(registry=reg, tracer=tracer, requests=rt) as ms:
+        text = urllib.request.urlopen(ms.url("/metrics")).read().decode()
+        assert "t_live_total 3" in text
+        reg.counter("t_live_total").inc()  # LIVE: next scrape sees it
+        text = urllib.request.urlopen(ms.url("/metrics")).read().decode()
+        assert "t_live_total 4" in text
+        traces = json.load(urllib.request.urlopen(ms.url("/traces")))
+        assert any(
+            e.get("name") == "request" for e in traces["traceEvents"]
+        )
+        reqs = json.load(urllib.request.urlopen(ms.url("/requests")))
+        assert reqs["active"][0]["trace_id"] == "live"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ms.url("/nope"))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: serving-crash dump carries the request registry
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_dump_includes_request_traces(tmp_path):
+    rt = RequestTraceRegistry()
+    ctx = TraceContext("crash")
+    rt.start(ctx, 4)
+    rt.event(ctx.request_id, "admission", slot=0, bucket=8)
+    rec = FlightRecorder(
+        str(tmp_path / "fr"), tracer=SpanTracer(),
+        registry=MetricsRegistry(), requests=rt,
+    )
+    path = rec.dump("unit-test")
+    doc = json.load(open(path))
+    (active,) = doc["request_traces"]["active"]
+    assert active["request_id"] == "crash/0"
+    assert [e["name"] for e in active["events"]] == ["submit", "admission"]
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_engine_thread_crash_dumps_flight_recorder(tmp_path):
+    """A serving crash (engine thread re-raises) must leave a flight
+    dump whose request_traces section parses and shows the in-flight
+    request — the previously-lost post-mortem state."""
+    rt = RequestTraceRegistry()
+    rec = FlightRecorder(
+        str(tmp_path / "fr"), tracer=SpanTracer(),
+        registry=MetricsRegistry(), requests=rt,
+    )
+    prev_hook = threading.excepthook
+    try:
+        rec.install(sigterm=False)
+        ctx = TraceContext("dying")
+        rt.start(ctx, 3)
+
+        def engine_loop():
+            raise RuntimeError("simulated device OOM mid-serving")
+
+        t = threading.Thread(target=engine_loop, name="serve-engine")
+        t.start()
+        t.join(timeout=10)
+        deadline = time.monotonic() + 10
+        while rec.last_dump_path is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        threading.excepthook = prev_hook
+    assert rec.last_dump_path and os.path.exists(rec.last_dump_path)
+    doc = json.load(open(rec.last_dump_path))
+    assert doc["reason"].startswith("thread-exception-serve-engine")
+    assert "simulated device OOM" in doc["detail"]
+    (active,) = doc["request_traces"]["active"]
+    assert active["request_id"] == "dying/0"
+
+
+# ---------------------------------------------------------------------------
+# concurrency: engine threads + watcher + live scrape racing appends
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_registry_and_scrape_race_cleanly():
+    """Engine-style writer threads (span appends, exemplar observes,
+    trace events), a watcher-style thread (snapshots + chrome export)
+    and a live /metrics scraper all race for a while; everything stays
+    consistent and parseable throughout."""
+    tracer = SpanTracer(capacity=256)
+    reg = MetricsRegistry()
+    rt = RequestTraceRegistry(capacity=64, max_active=64)
+    h = reg.histogram("t_race_seconds", buckets=DEFAULT_SLO_BUCKETS)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def guard(fn):
+        def run():
+            try:
+                i = 0
+                while not stop.is_set():
+                    fn(i)
+                    i += 1
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(f"{type(e).__name__}: {e}")
+
+        return run
+
+    def writer(i):
+        ctx = TraceContext(f"w{threading.get_ident()}-{i}")
+        rt.start(ctx, 4)
+        with tracer.span("serve.decode_step", active=i % 8):
+            h.observe(0.0001 * (i % 100), exemplar=ctx.request_id)
+        rt.decode_ticks((ctx.request_id,) * 4)
+        rt.finish(ctx.request_id, "max_tokens", tokens=4)
+
+    def watcher(i):
+        reg.snapshot({"i": i})
+        tracer.trace_events()
+        rt.snapshot()
+
+    with MetricsServer(registry=reg, tracer=tracer, requests=rt) as ms:
+        def scraper(i):
+            body = urllib.request.urlopen(ms.url("/metrics")).read()
+            assert b"t_race_seconds_count" in body
+            json.load(urllib.request.urlopen(ms.url("/requests")))
+
+        threads = [
+            threading.Thread(target=guard(fn))
+            for fn in (writer, writer, writer, watcher, scraper)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert errors == []
+    assert h.count > 0 and len(h.exemplars()) == 8
+    # every retained trace is internally consistent
+    for tr in rt.completed():
+        assert tr.finish_reason in ("max_tokens", "truncated")
+    json.dumps(rt.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: loadgen -> ServeServer -> Engine round-trip
+# ---------------------------------------------------------------------------
+
+
+class _StubWatcher:
+    """One staged swap, engine-thread protocol only (take/reject/stop)."""
+
+    def __init__(self, staged):
+        self._staged = [staged]
+
+    def take(self):
+        return self._staged.pop() if self._staged else None
+
+    def reject(self, staged=None):  # pragma: no cover - mismatch path
+        raise AssertionError("same-tree swap must not be rejected")
+
+    def stop(self):
+        pass
+
+
+def test_e2e_loadgen_server_engine_traces_and_exemplars(tmp_path, monkeypatch):
+    """The acceptance round-trip: socket loadgen drives a ServeServer
+    over a tight paged pool with a mid-traffic hot swap. Every completed
+    request's trace carries submit→admission→prefill→decode→completion
+    (preempt/hotswap events present where induced), and the p99 TTFT
+    exemplars on BOTH the client and server snapshots resolve to real
+    recorded traces in the merged report, joined by trace_id."""
+    from consensusml_tpu.obs import ClusterWriter, aggregate, get_registry
+    from consensusml_tpu.obs import metrics as metrics_mod
+    from consensusml_tpu.obs import requests as requests_mod
+    from consensusml_tpu.serve import Engine, ServeConfig, ServeServer
+    from consensusml_tpu.serve.pool.hotswap import StagedSwap
+    from tools.loadgen import _socket_submit, run_loadgen
+
+    # fresh process-wide registries: earlier in-process serving runs
+    # must not leak exemplars/traces into the acceptance assertions
+    monkeypatch.setattr(metrics_mod, "_GLOBAL", MetricsRegistry())
+    monkeypatch.setattr(requests_mod, "_GLOBAL", RequestTraceRegistry())
+    rt = get_request_registry()
+    model = _tiny_gpt2()
+    params = _init(model)
+    # 10 blocks cannot hold 4 full streams -> recompute-preemption fires
+    engine = Engine(
+        model, params,
+        ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", block_size=8,
+            num_blocks=10, max_new_tokens=8,
+        ),
+    )
+    server = ServeServer(engine, metrics_port=0)
+    try:
+        engine.warmup()
+        host, port = server.address
+        report = run_loadgen(
+            _socket_submit(host, port),
+            n_requests=8, rate_rps=300.0, prompt_lens=(4, 16),
+            vocab=64, max_new_tokens=8, seed=3,
+        )
+        assert report["errors"] == 0 and report["completed"] == 8
+
+        # induce a drain-free hot swap under live streams: let the
+        # streams become resident first, then stage the same tree as
+        # generation 2 — the flip lands between two decode steps and
+        # stamps every resident slot's trace
+        long_handles = [
+            engine.submit([7, 8, 9, 10], max_new_tokens=16,
+                          trace=TraceContext(f"swp-{i}"))
+            for i in range(3)
+        ]
+        deadline = time.monotonic() + 60
+        while engine._table.num_active < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert engine._table.num_active >= 3
+        engine._watcher = _StubWatcher(
+            StagedSwap(generation=2, params=engine._params, meta={})
+        )
+        results = [h.result(timeout=120) for h in long_handles]
+        assert engine.generation == 2
+        assert any(r.generation == 2 for r in results)
+
+        # live /metrics on the serving side, fresh per scrape
+        murl = (
+            f"http://{server.metrics_address[0]}:"
+            f"{server.metrics_address[1]}/metrics"
+        )
+        text = urllib.request.urlopen(murl).read().decode()
+        assert "consensusml_serve_ttft_seconds_bucket" in text
+    finally:
+        server.shutdown(drain=True)
+
+    # ---- every completed request: the full event chain ------------------
+    done = {
+        tr.request_id: tr
+        for tr in rt.completed()
+        if tr.finish_reason in ("eos", "max_tokens")
+    }
+    lg = [tr for rid, tr in done.items() if rid.startswith("lg3-")]
+    assert len(lg) == 8  # client-minted ids reached the server verbatim
+    for tr in done.values():
+        names = [e["name"] for e in tr.to_dict()["events"]]
+        for stage in ("submit", "admission", "prefill", "decode", "complete"):
+            assert stage in names, (tr.request_id, names)
+        assert names.index("submit") < names.index("admission")
+        assert names.index("prefill") < names.index("decode")
+        assert tr.decode_ticks > 0
+    # induced events landed on the traces they belong to
+    assert engine.stats()["evictions"] > 0
+    preempted = [tr for tr in done.values() if tr.preemptions]
+    assert preempted, "tight pool must have preempted at least one stream"
+    for tr in preempted:  # re-admission after preemption is on the trace
+        names = [e["name"] for e in tr.to_dict()["events"]]
+        assert names.count("admission") >= 2
+    swapped = [tr for rid, tr in done.items() if rid.startswith("swp-")]
+    assert len(swapped) == 3
+    assert any(
+        "hotswap" in [e["name"] for e in tr.to_dict()["events"]]
+        and tr.generation == 2
+        for tr in swapped
+    ), "the induced generation flip must land on a resident stream's trace"
+
+    # ---- client + server snapshots: p99 exemplars resolve ---------------
+    obs_dir = tmp_path / "obs"
+    reg = get_registry()
+    ClusterWriter(str(obs_dir), rank=0, role="serve", registry=reg).write(
+        extra={"request_traces": rt.snapshot()}
+    )
+    ClusterWriter(str(obs_dir), rank=1, role="loadgen", registry=reg).write(
+        extra={"report": report, "request_traces": rt.snapshot()}
+    )
+    doc = aggregate(str(obs_dir))
+    req = doc["requests"]
+    assert req["traces_indexed"] >= 11
+    by_metric: dict = {}
+    for row in req["slowest"]:
+        by_metric.setdefault(row["metric"], []).append(row)
+    for fam in ("consensusml_serve_ttft_seconds",
+                "consensusml_loadgen_ttft_seconds"):
+        rows = by_metric[fam]
+        top = rows[0]  # worst-first == the p99-governing observation
+        assert top["resolved"], (fam, top)
+        assert top["request_id"] in done
+        assert top["trace_id"] == done[top["request_id"]].trace_id
+    # client and server rows of one request join on trace_id
+    client_ids = {r["trace_id"] for r in by_metric["consensusml_loadgen_ttft_seconds"]}
+    server_ids = {r["trace_id"] for r in by_metric["consensusml_serve_ttft_seconds"]}
+    assert client_ids & server_ids, "no request seen from both sides"
+
+    # the report renders the table + determinism of the merge
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([str(obs_dir)]) == 0

@@ -186,9 +186,12 @@ def test_flight_recorder_sigterm_dump_chains(tmp_path):
         pass
     r.counter("t_rounds_total").inc(2)
     rec = FlightRecorder(str(tmp_path / "fr"), tracer=t, registry=r)
+    import threading
+
     seen = []
     prev_sig = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
     prev_hook = sys.excepthook
+    prev_thread_hook = threading.excepthook
     try:
         rec.install(sigterm=True)
         _os.kill(_os.getpid(), signal.SIGTERM)
@@ -198,6 +201,7 @@ def test_flight_recorder_sigterm_dump_chains(tmp_path):
     finally:
         signal.signal(signal.SIGTERM, prev_sig)
         sys.excepthook = prev_hook
+        threading.excepthook = prev_thread_hook
     assert seen == [signal.SIGTERM]  # the chained handler ran
     assert rec.last_dump_path and os.path.exists(rec.last_dump_path)
     doc = json.load(open(rec.last_dump_path))
@@ -208,10 +212,12 @@ def test_flight_recorder_sigterm_dump_chains(tmp_path):
 
 def test_flight_recorder_excepthook_chains(tmp_path):
     import sys
+    import threading
 
     t, r = SpanTracer(), MetricsRegistry()
     rec = FlightRecorder(str(tmp_path / "fr"), tracer=t, registry=r)
     prev_hook = sys.excepthook
+    prev_thread_hook = threading.excepthook
     seen = []
     sys.excepthook = lambda *a: seen.append(a)
     try:
@@ -222,6 +228,7 @@ def test_flight_recorder_excepthook_chains(tmp_path):
             sys.excepthook(*sys.exc_info())
     finally:
         sys.excepthook = prev_hook
+        threading.excepthook = prev_thread_hook
     assert rec.last_dump_path and os.path.exists(rec.last_dump_path)
     doc = json.load(open(rec.last_dump_path))
     assert doc["reason"] == "unhandled-exception"
@@ -374,7 +381,7 @@ def test_xprof_summary_host_trace_groups_spans(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_train_smoke_writes_prom_and_trace(tmp_path):
+def test_train_smoke_writes_prom_and_trace(tmp_path, capsys):
     import train as train_cli
 
     trace_path = tmp_path / "trace.json"
@@ -391,11 +398,15 @@ def test_train_smoke_writes_prom_and_trace(tmp_path):
                 "--telemetry-every", "2",
                 "--trace-events", str(trace_path),
                 "--metrics-prom", str(prom_path),
+                "--metrics-port", "0",
             ]
         )
     finally:
         tracer.enabled = was_enabled
     assert rc == 0
+    # the live /metrics endpoint came up on a free port and was
+    # announced (closed again by the CLI's exit stack)
+    assert "metrics endpoint: http://127.0.0.1:" in capsys.readouterr().out
 
     # (a) Perfetto-loadable trace with nested gossip.round -> bucket spans
     doc = json.load(open(trace_path))

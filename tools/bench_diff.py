@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff a fresh bench JSON vs the trajectory.
+
+The checked-in ``BENCH_r0*.json`` files are the perf trajectory (one
+compact record per bench round: ``parsed.metric/value/vs_baseline``) and
+``BENCH_DETAIL.json`` is the latest round's full section detail. This
+tool turns that archive into a GATE: compare a fresh bench result
+against the trajectory under a per-metric **direction + tolerance
+spec** and exit non-zero on regression, so a PR that slows the headline
+or blows an overhead budget fails loudly instead of shipping a slower
+number into the archive.
+
+Spec semantics (``--spec FILE`` overrides the built-in ``DEFAULT_SPEC``;
+one entry per metric):
+
+- ``direction: "up"``   — higher is better; regression when
+  ``fresh < ref * (1 - tol_pct/100)`` (e.g. ``value`` = imgs/s/chip);
+- ``direction: "down"`` — lower is better; regression when
+  ``fresh > ref * (1 + tol_pct/100)`` (e.g. a ttft_p99_ms);
+- ``direction: "max"``  — absolute budget, no reference needed;
+  regression when ``fresh > bound`` (e.g. the observability plane's
+  overhead_pct must stay under 1%).
+
+``key`` is a dotted path: top-level keys (``value``, ``vs_baseline``)
+resolve in the compact record, dotted keys (``observability.
+link_probe_overhead_pct``) in the section detail. Metrics missing on
+either side are reported as ``skipped`` — a spec can stay ahead of the
+sections the bench grows — and ``--strict`` turns skips into failures.
+
+    python tools/bench_diff.py BENCH_fresh.json            # text report
+    python tools/bench_diff.py BENCH_fresh.json --json -   # machine-readable
+    python tools/bench_diff.py BENCH_r05.json              # self-check: the
+                                                           # archive is clean
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Direction + tolerance per metric. Tolerances are deliberately loose on
+# wall-clock-noisy section metrics (shared CI hosts) and tight on the
+# budget bounds the docs promise.
+DEFAULT_SPEC = [
+    {"key": "value", "direction": "up", "tol_pct": 15.0,
+     "label": "headline imgs/s/chip"},
+    {"key": "vs_baseline", "direction": "up", "tol_pct": 15.0},
+    {"key": "serving.ttft_p99_ms", "direction": "down", "tol_pct": 50.0},
+    {"key": "serving.decode_tokens_per_sec", "direction": "up",
+     "tol_pct": 50.0},
+    {"key": "gossip_round.gossip_round_ms", "direction": "down",
+     "tol_pct": 50.0},
+    {"key": "gpt2.tokens_sec", "direction": "up", "tol_pct": 30.0},
+    {"key": "fed_input.native_loader_u8.imgs_sec", "direction": "up",
+     "tol_pct": 30.0},
+    # budgets documented in docs/observability.md — absolute, always on
+    {"key": "observability.link_probe_overhead_pct", "direction": "max",
+     "bound": 1.0},
+    {"key": "observability.request_tracing_overhead_pct",
+     "direction": "max", "bound": 1.0},
+]
+
+
+def _get_path(doc: dict, dotted: str):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _flatten(doc: dict) -> dict:
+    """Normalize either record shape to one lookup dict: a trajectory
+    point (``{parsed: {...}}``) exposes its ``parsed`` keys at top
+    level; a detail doc (``BENCH_DETAIL.json`` / a fresh bench emit)
+    already carries sections + headline keys together."""
+    if isinstance(doc.get("parsed"), dict):
+        merged = dict(doc)
+        merged.update(doc["parsed"])
+        return merged
+    return doc
+
+
+def load_trajectory(repo_root: str, patterns: list[str] | None = None):
+    """(reference_doc, provenance): the newest trajectory point's compact
+    record merged UNDER the section detail, so dotted keys resolve when
+    the detail file carries them."""
+    pats = patterns or ["BENCH_r0*.json"]
+    points = []
+    for pat in pats:
+        for path in sorted(glob.glob(os.path.join(repo_root, pat))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            points.append((doc.get("n", 0), path, _flatten(doc)))
+    if not points:
+        return None, []
+    points.sort(key=lambda t: t[0])
+    _n, latest_path, ref = points[-1]
+    provenance = [p for _, p, _ in points]
+    detail_path = os.path.join(repo_root, "BENCH_DETAIL.json")
+    if os.path.exists(detail_path):
+        try:
+            with open(detail_path) as f:
+                detail = json.load(f)
+            merged = dict(detail)
+            merged.update({k: v for k, v in ref.items() if k not in merged})
+            ref = merged
+            provenance.append(detail_path)
+        except (OSError, ValueError):
+            pass
+    return ref, provenance
+
+
+def diff(fresh: dict, ref: dict | None, spec: list[dict]) -> dict:
+    fresh = _flatten(fresh)
+    rows = []
+    for entry in spec:
+        key = entry["key"]
+        direction = entry["direction"]
+        fv = _get_path(fresh, key)
+        row = {
+            "key": key,
+            "direction": direction,
+            "fresh": fv,
+            "ref": None,
+            "status": "ok",
+        }
+        if direction == "max":
+            bound = float(entry["bound"])
+            row["bound"] = bound
+            if fv is None:
+                row["status"] = "skipped"
+                row["why"] = "metric absent from fresh result"
+            elif fv > bound:
+                row["status"] = "regression"
+                row["why"] = f"{fv:g} exceeds the absolute budget {bound:g}"
+        else:
+            tol = float(entry.get("tol_pct", 0.0))
+            rv = _get_path(ref, key) if ref else None
+            row["ref"] = rv
+            row["tol_pct"] = tol
+            if fv is None or rv is None:
+                row["status"] = "skipped"
+                row["why"] = (
+                    "metric absent from fresh result"
+                    if fv is None
+                    else "metric absent from trajectory"
+                )
+            elif direction == "up" and fv < rv * (1 - tol / 100):
+                row["status"] = "regression"
+                row["why"] = (
+                    f"{fv:g} is {100 * (1 - fv / rv):.1f}% below the "
+                    f"trajectory's {rv:g} (tolerance {tol:g}%)"
+                )
+            elif direction == "down" and fv > rv * (1 + tol / 100):
+                row["status"] = "regression"
+                row["why"] = (
+                    f"{fv:g} is {100 * (fv / rv - 1):.1f}% above the "
+                    f"trajectory's {rv:g} (tolerance {tol:g}%)"
+                )
+        rows.append(row)
+    regressions = [r for r in rows if r["status"] == "regression"]
+    skipped = [r for r in rows if r["status"] == "skipped"]
+    return {
+        "ok": not regressions,
+        "rows": rows,
+        "counts": {
+            "checked": len(rows) - len(skipped),
+            "regressions": len(regressions),
+            "skipped": len(skipped),
+        },
+    }
+
+
+def render_text(report: dict, provenance: list[str]) -> str:
+    lines = []
+    for r in report["rows"]:
+        mark = {"ok": "ok  ", "skipped": "skip", "regression": "FAIL"}[
+            r["status"]
+        ]
+        ref = (
+            f" vs {r['ref']:g} ±{r.get('tol_pct', 0):g}%"
+            if r.get("ref") is not None
+            else (f" <= {r['bound']:g}" if "bound" in r else "")
+        )
+        fresh = "-" if r["fresh"] is None else f"{r['fresh']:g}"
+        lines.append(
+            f"[{mark}] {r['key']:<42} {r['direction']:>4}  {fresh}{ref}"
+            + (f"  ({r['why']})" if "why" in r else "")
+        )
+    c = report["counts"]
+    verdict = "PASSED" if report["ok"] else "FAILED"
+    lines.append(
+        f"bench-diff {verdict}: {c['checked']} checked, "
+        f"{c['regressions']} regression(s), {c['skipped']} skipped "
+        f"(trajectory: {len(provenance)} file(s))"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("fresh", help="fresh bench JSON (a BENCH_DETAIL-style "
+                                 "doc or a compact trajectory record)")
+    p.add_argument("--repo-root", default=_REPO_ROOT,
+                   help="where the BENCH_r0*.json trajectory lives")
+    p.add_argument("--trajectory", nargs="*", default=None, metavar="GLOB",
+                   help="trajectory file patterns relative to --repo-root "
+                        "(default: BENCH_r0*.json + BENCH_DETAIL.json)")
+    p.add_argument("--spec", default=None,
+                   help="JSON spec file overriding the built-in "
+                        "direction+tolerance table")
+    p.add_argument("--strict", action="store_true",
+                   help="treat skipped metrics as failures")
+    p.add_argument("--json", metavar="PATH", default=None,
+                   help="write the machine-readable report ('-' = stdout)")
+    args = p.parse_args(argv)
+
+    try:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read fresh bench JSON {args.fresh}: {e}",
+              file=sys.stderr)
+        return 2
+    spec = DEFAULT_SPEC
+    if args.spec:
+        try:
+            with open(args.spec) as f:
+                spec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read spec {args.spec}: {e}",
+                  file=sys.stderr)
+            return 2
+    ref, provenance = load_trajectory(args.repo_root, args.trajectory)
+    if ref is None:
+        print(
+            f"error: no trajectory files under {args.repo_root} "
+            "(expected BENCH_r0*.json)",
+            file=sys.stderr,
+        )
+        return 2
+    report = diff(fresh, ref, spec)
+    if args.strict and report["counts"]["skipped"]:
+        report["ok"] = False
+    out = render_text(report, provenance)
+    if args.json:
+        doc = json.dumps(report, indent=2)
+        if args.json == "-":
+            print(doc)
+        else:
+            with open(args.json, "w") as f:
+                f.write(doc + "\n")
+            print(out)
+    else:
+        print(out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """cml-check: static analysis gate for the gossip training stack.
 
-Runs the four analysis passes (see docs/static_analysis.md) and exits
+Runs the five analysis passes (see docs/static_analysis.md) and exits
 non-zero on any finding not suppressed by the baseline file:
 
     python tools/cml_check.py --all                # the tier-1 gate
@@ -25,6 +25,9 @@ Passes:
                 step-over-step canonical-jaxpr stability per stage =
                 zero serving recompiles
   --locks       lock-discipline race lint over @guarded_by classes
+  --docs        docs-drift: every consensusml_* metric family emitted
+                in code must appear in docs/observability.md, and doc
+                entries no code emits are flagged stale
 
 Exit codes: 0 clean (or everything suppressed), 1 active findings,
 2 internal error. CPU-only, trace-only: safe on any dev box and in CI.
@@ -81,6 +84,10 @@ def run_passes(selected: list[str], roots: list[str]):
         from consensusml_tpu.analysis import locks
 
         findings += locks.lint_paths(roots, _REPO_ROOT)
+    if "docs-drift" in selected:
+        from consensusml_tpu.analysis import docs_drift
+
+        findings += docs_drift.check_repo(_REPO_ROOT)
     if "schedule" in selected:
         _force_cpu()
         from consensusml_tpu.analysis import schedule
@@ -111,11 +118,12 @@ def main(argv=None) -> int:
         prog="cml-check", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("--all", action="store_true", help="run all four passes")
+    ap.add_argument("--all", action="store_true", help="run all five passes")
     ap.add_argument("--host-sync", action="store_true")
     ap.add_argument("--schedule", action="store_true")
     ap.add_argument("--jaxpr", action="store_true")
     ap.add_argument("--locks", action="store_true")
+    ap.add_argument("--docs", action="store_true")
     ap.add_argument(
         "--paths", nargs="*", default=None,
         help="files/dirs for the AST passes (default: consensusml_tpu/)",
@@ -140,6 +148,7 @@ def main(argv=None) -> int:
         for name, on in (
             ("host-sync", args.host_sync),
             ("locks", args.locks),
+            ("docs-drift", args.docs),
             ("schedule", args.schedule),
             ("jaxpr", args.jaxpr),
         )

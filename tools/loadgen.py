@@ -72,14 +72,19 @@ def run_loadgen(
     swap_every: int = 0,
     swap_fn=None,
 ) -> dict:
-    """Open-loop driver over any ``submit(ids, max_new) -> result_dict``
-    callable (``result_dict``: ``ttft_s``, ``latency_s``, ``tokens``).
+    """Open-loop driver over any ``submit(ids, max_new, ctx) ->
+    result_dict`` callable (``result_dict``: ``ttft_s``, ``latency_s``,
+    ``tokens``; ``ctx`` is the minted
+    :class:`~consensusml_tpu.obs.TraceContext` the submitter should
+    propagate so the server's trace joins the client's observation).
     Each arrival runs on its own thread so a slow request never delays
     the next arrival (that is what makes the loop open). With
     ``swap_every`` + ``swap_fn``, every ``swap_every``-th arrival first
     triggers ``swap_fn()`` (the hot-swap poke: bump the artifact's
     generation mid-traffic) — tail latency under live reload is part of
     the SLO story, not a separate benchmark."""
+    from consensusml_tpu.obs import TraceContext
+
     rng = np.random.default_rng(seed)
     lo, hi = prompt_lens
     results: list[dict] = []
@@ -88,9 +93,11 @@ def run_loadgen(
     threads = []
     swaps = 0
 
-    def one(ids):
+    def one(ids, ctx):
         try:
-            r = submit(ids, max_new_tokens)
+            r = submit(ids, max_new_tokens, ctx)
+            r.setdefault("trace_id", ctx.trace_id)
+            r.setdefault("request_id", ctx.request_id)
             with lock:
                 results.append(r)
         except Exception as e:
@@ -104,7 +111,11 @@ def run_loadgen(
             swaps += 1
         n = sample_prompt_len(rng, lo, hi, len_dist)
         ids = rng.integers(0, vocab - 1, size=n)
-        t = threading.Thread(target=one, args=(list(map(int, ids)),))
+        # deterministic trace identity (seed + arrival index): the same
+        # fixture replays to the same ids, and client + server sides of
+        # one request join on trace_id (docs/observability.md)
+        ctx = TraceContext(f"lg{seed:x}-{i:05d}")
+        t = threading.Thread(target=one, args=(list(map(int, ids)), ctx))
         threads.append(t)
         t.start()
         # exponential inter-arrival gap == Poisson arrivals
@@ -118,7 +129,20 @@ def run_loadgen(
     )
     tokens_out = int(sum(len(r["tokens"]) for r in results))
     _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall)
+    # the client-observed worst tail, with identity: each row's
+    # trace_id/request_id resolves to a server-side RequestTrace
+    slowest = sorted(results, key=lambda r: -r["latency_s"])[:8]
     return {
+        "slowest": [
+            {
+                "trace_id": r.get("trace_id", ""),
+                "request_id": r.get("request_id", ""),
+                "ttft_ms": round(1e3 * r["ttft_s"], 3),
+                "latency_ms": round(1e3 * r["latency_s"], 3),
+                "tokens": len(r["tokens"]),
+            }
+            for r in slowest
+        ],
         "requests": n_requests,
         "completed": len(results),
         "errors": len(errors),
@@ -157,8 +181,10 @@ def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
         buckets=DEFAULT_SLO_BUCKETS,
     )
     for r in results:
-        ttft.observe(r["ttft_s"])
-        lat.observe(r["latency_s"])
+        # exemplar-bearing: the worst buckets remember WHICH request
+        rid = r.get("request_id") or None
+        ttft.observe(r["ttft_s"], exemplar=rid)
+        lat.observe(r["latency_s"], exemplar=rid)
     reg.counter(
         "consensusml_loadgen_requests_total", "requests issued"
     ).inc(n_requests)
@@ -183,22 +209,27 @@ def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
 
 
 def _engine_submit(engine):
-    def submit(ids, max_new):
-        h = engine.submit(ids, max_new)
+    def submit(ids, max_new, ctx=None):
+        h = engine.submit(ids, max_new, trace=ctx)
         r = h.result(timeout=300)
-        return {"ttft_s": r.ttft_s, "latency_s": r.latency_s, "tokens": r.tokens}
+        return {
+            "ttft_s": r.ttft_s, "latency_s": r.latency_s, "tokens": r.tokens,
+            "trace_id": r.trace_id, "request_id": r.request_id,
+        }
 
     return submit
 
 
 def _socket_submit(host: str, port: int):
-    def submit(ids, max_new):
+    def submit(ids, max_new, ctx=None):
         t0 = time.perf_counter()
+        req = {"ids": ids, "max_new_tokens": max_new}
+        if ctx is not None:
+            req["trace_id"] = ctx.trace_id
+            req["request_id"] = ctx.request_id
         with socket.create_connection((host, port), timeout=300) as conn:
             f = conn.makefile("rwb")
-            f.write(
-                json.dumps({"ids": ids, "max_new_tokens": max_new}).encode() + b"\n"
-            )
+            f.write(json.dumps(req).encode() + b"\n")
             f.flush()
             ttft = None
             tokens = []
@@ -211,6 +242,10 @@ def _socket_submit(host: str, port: int):
                         "ttft_s": ttft if ttft is not None else 0.0,
                         "latency_s": time.perf_counter() - t0,
                         "tokens": msg["tokens"],
+                        # server-echoed identity (joins on trace_id even
+                        # if the server minted its own request_id)
+                        "trace_id": msg.get("trace_id", ""),
+                        "request_id": msg.get("request_id", ""),
                     }
                 if ttft is None:  # first streamed token, client-observed
                     ttft = time.perf_counter() - t0
@@ -293,11 +328,20 @@ def main(argv=None) -> int:
         report["engine"] = engine.stats()
         engine.shutdown()
     if args.obs_snapshot:
-        from consensusml_tpu.obs import ClusterWriter
+        from consensusml_tpu.obs import ClusterWriter, get_request_registry
 
+        # in-process mode the engine fed this process's request-trace
+        # registry, so the snapshot carries the server-side traces the
+        # exemplar request_ids resolve against; socket mode leaves it to
+        # the server's own snapshot
         path = ClusterWriter(
             args.obs_snapshot, rank=args.seed, role="loadgen"
-        ).write(extra={"report": report})
+        ).write(
+            extra={
+                "report": report,
+                "request_traces": get_request_registry().snapshot(),
+            }
+        )
         print(f"obs snapshot: {path}", flush=True)
     print("LOADGEN " + json.dumps(report), flush=True)
     return 0 if report["errors"] == 0 else 1

@@ -5,10 +5,14 @@ Merges every rank's ``obs-*.json`` snapshot (and any loadgen/client
 snapshots and flight-recorder dumps living in the same directory) into
 one report: per-rank round/latency skew, slowest-link ranking with the
 bytes each edge carries, measured-vs-bound consensus health, straggler
-detection, churn counters, and the swarm membership timeline
+detection, churn counters, the swarm membership timeline
 (join/drop/straggler events vs round, with each join's gossip-bootstrap
-cost and epsilon). See docs/observability.md "Cluster view" and
-docs/elasticity.md.
+cost and epsilon), the SLOWEST-REQUEST table (SLO histogram exemplars
+resolved against the merged request-trace index — client and server
+sides of one request join on trace_id), and the cross-rank ROUND
+TIMELINE attributing straggler rounds to phase (feed vs gossip vs
+compute). See docs/observability.md "Cluster view" / "Request tracing"
+and docs/elasticity.md.
 
     python tools/obs_report.py /shared/obs            # text report
     python tools/obs_report.py /shared/obs --json     # full JSON doc
@@ -137,6 +141,58 @@ def render_text(doc: dict) -> str:
                     f"{glyph.get(row.get('kind'), '?')} "
                     f"{row.get('kind'):<8} {ws}{extra}"
                 )
+    req = doc.get("requests") or {}
+    if req.get("traces_indexed") or req.get("slowest"):
+        add("")
+        add(
+            f"request traces: {req.get('traces_indexed', 0)} indexed "
+            f"({req.get('in_flight', 0)} in flight)"
+        )
+        if req.get("slowest"):
+            add("slowest requests (SLO exemplars -> traces):")
+            add("  metric                               side    value      request_id            trace")
+            for r in req["slowest"]:
+                tr = r.get("trace") or {}
+                detail = (
+                    f"ok ticks={tr.get('decode_ticks', 0)}"
+                    + (
+                        f" defer={tr['defer_ticks']}"
+                        if tr.get("defer_ticks")
+                        else ""
+                    )
+                    + (
+                        f" preempt={tr['preemptions']}"
+                        if tr.get("preemptions")
+                        else ""
+                    )
+                    if r.get("resolved")
+                    else "UNRESOLVED"
+                )
+                add(
+                    f"  {r['metric']:<36} {r['side']:<7} "
+                    f"{_fmt_s(r['value_s']):>9}  "
+                    f"{str(r.get('request_id')):<20}  {detail}"
+                )
+    timeline = doc.get("round_timeline") or []
+    if timeline:
+        add("")
+        add("round timeline (cross-rank, straggler time by phase):")
+        for row in timeline:
+            ranks = " | ".join(
+                f"r{r['rank']} {r['dur_ms']:.1f}ms" for r in row["ranks"]
+            )
+            st = row.get("straggler")
+            extra = ""
+            if st:
+                parts = [f"feed {st['feed_ms']:.1f}"]
+                if st.get("gossip_ms_est") is not None:
+                    parts.append(f"gossip~{st['gossip_ms_est']:.1f}")
+                    parts.append(f"compute~{st['compute_ms_est']:.1f}")
+                extra = (
+                    f"   straggler r{st['rank']} +{st['extra_ms']:.1f}ms "
+                    f"-> {st['phase']} ({', '.join(parts)})"
+                )
+            add(f"  {row['round']:>5}  {ranks}{extra}")
     if doc["flight_recorders"]:
         add("flight recorders:")
         for fr in doc["flight_recorders"]:

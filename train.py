@@ -188,6 +188,14 @@ def parse_args(argv=None):
                         "textfile here (atomically, every --telemetry-every "
                         "rounds and at exit; point a node-exporter textfile "
                         "collector at its directory)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve the live observability endpoints over HTTP "
+                        "on this port (0 = pick a free one): /metrics is "
+                        "the Prometheus text exposition rendered fresh per "
+                        "scrape (same locked expose() path as "
+                        "--metrics-prom), /traces the merged Chrome trace, "
+                        "/requests the request-trace registry snapshot "
+                        "(docs/observability.md 'Request tracing')")
     p.add_argument("--telemetry-every", type=int, default=10, metavar="N",
                    help="cadence (rounds) for the heavier telemetry: metric "
                         "snapshots, Prometheus rewrite, and the CHOCO "
@@ -766,11 +774,22 @@ def main(argv=None) -> int:
         or args.flight_recorder
         or args.obs_cluster_dir
         or args.link_probes
+        or args.metrics_port is not None
     )
     if telemetry_on:
         # host span recording on; without any sink the tracer stays
         # disabled and spans are bare jax.named_scopes (dict-cheap path)
         tracer.enabled = True
+    metrics_http = None
+    if args.metrics_port is not None:
+        from consensusml_tpu.obs import MetricsServer
+
+        metrics_http = MetricsServer(port=args.metrics_port)
+        print(
+            f"metrics endpoint: {metrics_http.url()} "
+            "(/metrics /traces /requests)",
+            flush=True,
+        )
     for k, v in engine.telemetry(param_shapes).items():
         registry.gauge(f"consensusml_{k}").set(v)
     recorder = None
@@ -948,6 +967,8 @@ def main(argv=None) -> int:
             stack.callback(
                 lambda: registry.write_prometheus(args.metrics_prom)
             )
+        if metrics_http is not None:
+            stack.callback(metrics_http.close)
         return _train_loop(
             args, bundle, engine, wire, step, state, start, backend,
             wmesh if backend == "collective" else None,
@@ -1374,6 +1395,18 @@ def _train_loop(
             m_latency.observe(timer.last_lap_s)
             m_heartbeat.set(time.time())
             m_progress.set(rnd)
+            if tracer.enabled:
+                # per-round phase spans for the cross-rank round
+                # timeline: the feed stall and the execution-fence wait
+                # are measured by the loop itself, recorded as synthetic
+                # spans stamped with the round id so the cluster
+                # aggregator can attribute straggler time to phase
+                tracer.complete(
+                    "round.feed",
+                    getattr(feed, "last_stall_s", 0.0),
+                    round=rnd,
+                )
+                tracer.complete("round.fence", timer.last_fence_s, round=rnd)
             if "consensus_error" in metrics:
                 cdist = float(metrics["consensus_error"])
                 registry.gauge(
