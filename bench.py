@@ -44,6 +44,7 @@ to a wedged tunnel + unbounded total):
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import subprocess
@@ -1258,6 +1259,208 @@ def _request_tracing_bench() -> dict:
     }
 
 
+def _attribution_bench() -> dict:
+    """Cost-attribution plane: what the compiled cost ledger KNOWS and
+    what it COSTS (docs/observability.md "Cost attribution").
+
+    Registers every bench workload family's executables in a ledger —
+    the mnist train step, one bucketed gossip round at small-CNN scale,
+    the tiny-GPT2 paged serving stages — then pairs each with a
+    measured wall time for the expected-vs-measured roofline rows, runs
+    the three-way HBM reconciliation (analytic hbm_model vs compiled
+    memory_analysis vs live arrays) on the mnist config, and prices the
+    RUN-TIME side of the plane (HBM accountant tick + attribution gauge
+    update, amortized at telemetry cadence) against a measured gossip
+    round — the <1%-of-a-round budget bench_diff enforces. Compile wall
+    times per executable feed the absolute compile budgets.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from consensusml_tpu import configs
+    from consensusml_tpu.comm import simulated
+    from consensusml_tpu.consensus import ConsensusEngine, GossipConfig
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+    from consensusml_tpu.obs.costs import CostLedger
+    from consensusml_tpu.obs.memviz import HbmAccountant, reconcile_config
+    from consensusml_tpu.obs.metrics import MetricsRegistry
+    from consensusml_tpu.serve import Engine, ServeConfig
+    from consensusml_tpu.topology import RingTopology
+    from consensusml_tpu.train import (
+        init_stacked_state,
+        make_simulated_train_step,
+    )
+
+    reg = MetricsRegistry()
+    ledger = CostLedger(registry=reg)
+    measured: dict[str, float] = {}
+
+    # -- three-way HBM reconciliation FIRST: live_arrays() is process-
+    # global, so the reconciled run must not see this section's later
+    # small-CNN gossip buffers as its own live bytes -------------------
+    hbm = reconcile_config("mnist_mlp", "smoke", registry=reg, ledger=ledger)
+    hbm_out = {
+        "analytic_bytes": hbm["analytic_bytes"],
+        "compiled_bytes": hbm["compiled_bytes"],
+        "live_peak_bytes": hbm["live_peak_bytes"],
+        "drift_pct": {
+            k: round(v, 2) for k, v in hbm["drift_pct"].items()
+        },
+    }
+
+    # -- train.step: the headline workload family at mnist scale ---------
+    bundle = configs.build("mnist_mlp", "smoke", world=4)
+    step = make_simulated_train_step(bundle.cfg, bundle.loss_fn)
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(0), 4
+    )
+    batch = next(iter(bundle.batches(1, 0)))
+    ledger.register("train.step", step, state, batch)
+    state, m = step(state, batch)  # compile + warm
+    jax.block_until_ready(m["loss"])
+    reps = 10
+    t0 = time.time()
+    for _ in range(reps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    measured["train.step"] = (time.time() - t0) / reps
+
+    # -- gossip.round: small-CNN-scale bucketed exact ring (the same
+    # geometry the observability section budgets against) ----------------
+    world = 8
+    topo = RingTopology(world)
+    geng = ConsensusEngine(
+        GossipConfig(topology=topo, bucket_bytes=4 << 20)
+    )
+    params = {
+        "w1": jnp.zeros((world, 784, 2048), jnp.float32),
+        "w2": jnp.zeros((world, 2048, 2048), jnp.float32),
+        "w3": jnp.zeros((world, 2048, 512), jnp.float32),
+        "b": jnp.zeros((world, 512), jnp.float32),
+    }
+    geng.register_costs(ledger, params)
+    w = simulated.mixing_matrix(topo)
+
+    @jax.jit
+    def round_fn(p):
+        mixed, _ = geng.round_simulated(p, None, w)
+        return mixed
+
+    params = round_fn(params)
+    jax.block_until_ready(params)
+    t0 = time.time()
+    for _ in range(20):
+        params = round_fn(params)
+    jax.block_until_ready(params)
+    round_ms = 1000 * (time.time() - t0) / 20
+    measured["gossip.round"] = round_ms / 1000
+
+    # -- serving stages: tiny GPT2 paged engine --------------------------
+    model = GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=64,
+            dropout=0.0,
+        )
+    )
+    gparams = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = Engine(
+        model, gparams,
+        ServeConfig(num_slots=8, max_len=64, max_new_tokens=16),
+    )
+    try:
+        engine.warmup()
+        engine.register_costs(ledger)
+        handles = [
+            engine.submit([1 + (i % 50)] * (4 + i % 9)) for i in range(16)
+        ]
+        for h in handles:
+            h.result(timeout=300)
+        stats = engine.stats()
+        measured["serve.decode"] = stats["intertoken_p50_ms"] / 1e3
+    finally:
+        engine.shutdown(drain=False)
+
+    # -- expected-vs-measured pairing for every workload -----------------
+    evm = {}
+    for name, secs in measured.items():
+        a = ledger.observe_measured(name, secs)
+        evm[name] = {
+            "measured_ms": round(1e3 * a["measured_s"], 4),
+            "expected_ms": round(1e3 * a["expected_s"], 4),
+            "bound": a["bound"],
+            "ratio_to_floor": round(a["ratio_to_floor"], 2),
+        }
+    missing = sum(
+        1
+        for name in ("train.step", "gossip.round", "serve.decode")
+        if name not in evm or not math.isfinite(evm[name]["expected_ms"])
+    )
+
+    # -- run-time overhead: accountant tick + attribution gauge update,
+    # amortized at the telemetry cadence, vs the measured gossip round --
+    cadence = 10
+    acct = HbmAccountant(registry=reg)
+    acct.tick()  # first tick pays lazy gauge registration
+    n = 50
+    t0 = time.time()
+    for _ in range(n):
+        acct.tick()
+    tick_ms = 1000 * (time.time() - t0) / n
+    t0 = time.time()
+    for _ in range(n):
+        ledger.observe_measured("gossip.round", measured["gossip.round"])
+    attr_ms = 1000 * (time.time() - t0) / n
+    per_round_ms = (tick_ms + attr_ms) / cadence
+
+    rows = []
+    compile_ms: dict[str, float] = {}
+    prefill_max = 0.0
+    for e in ledger.snapshot()["executables"]:
+        rows.append(
+            {
+                "executable": e["name"],
+                "kind": e["kind"],
+                "flops": e["flops"],
+                "bytes_accessed": e["bytes_accessed"],
+                "peak_bytes": e["peak_bytes"],
+                "compile_ms": round(1e3 * e["compile_s"], 2),
+                "expected_ms": round(1e3 * e["expected_s"], 4),
+                "bound": e["bound"],
+            }
+        )
+        if e["name"].startswith("serve.prefill."):
+            prefill_max = max(prefill_max, 1e3 * e["compile_s"])
+    compile_ms["train_step"] = round(
+        1e3 * ledger.row("train.step").compile_s, 2
+    )
+    compile_ms["gossip_round"] = round(
+        1e3 * ledger.row("gossip.round").compile_s, 2
+    )
+    compile_ms["serve_decode"] = round(
+        1e3 * ledger.row("serve.decode").compile_s, 2
+    )
+    compile_ms["serve_prefill_max"] = round(prefill_max, 2)
+
+    return {
+        "executables": rows,
+        "expected_vs_measured": evm,
+        "expected_vs_measured_missing": missing,
+        "compile_ms": compile_ms,
+        "hbm": hbm_out,
+        "gossip_round_ms": round(round_ms, 3),
+        "hbm_tick_ms": round(tick_ms, 4),
+        "attribution_update_ms": round(attr_ms, 4),
+        "attribution_cadence_rounds": cadence,
+        "attribution_plane_per_round_ms": round(per_round_ms, 4),
+        "attribution_overhead_pct": round(
+            100 * per_round_ms / max(round_ms, 1e-9), 3
+        ),
+    }
+
+
 def _elastic_bench() -> dict:
     """Elastic-swarm section: what live membership churn costs.
 
@@ -1627,6 +1830,9 @@ def main() -> None:
     if "--_obs" in sys.argv:
         print("INNER_RESULT " + json.dumps(_obs_bench()), flush=True)
         return
+    if "--_attribution" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_attribution_bench()), flush=True)
+        return
     if "--_elastic" in sys.argv:
         print("INNER_RESULT " + json.dumps(_elastic_bench()), flush=True)
         return
@@ -1860,6 +2066,11 @@ def main() -> None:
         "observability", "--_obs", 300,
         {"XLA_FLAGS": (flags + " --xla_force_host_platform_device_count=8").strip()},
     ))
+    # cost-attribution plane: per-executable compiled FLOPs/bytes/
+    # compile-ms, expected-vs-measured roofline rows for every workload
+    # family, three-way HBM reconciliation, and the <1%-of-a-round
+    # run-time budget (docs/observability.md "Cost attribution")
+    sections.append(("attribution", "--_attribution", 420, cpu_env))
     # elastic swarm: churn-vs-flat loss continuity, gossip-bootstrap
     # (join) cost in rounds, worst bootstrap epsilon — simulated backend,
     # CPU-capable (docs/elasticity.md)

@@ -1550,6 +1550,96 @@ class ConsensusEngine:
             "gossip_pipeline_depth": float(self.config.pipeline_depth),
         }
 
+    def register_costs(
+        self, ledger: Any, params: Any, *, name: str = "gossip.round"
+    ) -> Any:
+        """Lower + compile ONE simulated gossip round over ``params``
+        into the cost ledger (:mod:`consensusml_tpu.obs.costs`), tagged
+        with the active bucket plan.
+
+        ``params`` is the STACKED gossiped tree (leading worker axis);
+        shape structs are fine — nothing is materialized or executed,
+        and the jit dispatch caches are untouched (AOT lowering). The
+        row's ``meta`` carries the transport facts the attribution
+        report labels buckets with: bucket count and per-bucket packed
+        element counts from :meth:`bucket_plan`, per-worker wire bytes,
+        fused-wire/pipeline state. Overlap configs register their
+        transport twin (``overlap=False``) — the innovation exchange is
+        the same program family; the delayed-correction bookkeeping
+        lives in the train step's own row.
+
+        Stochastic codecs thread per-worker rng; warmup/refresh configs
+        thread the round counter — both become abstract arguments here
+        so every config family lowers. Returns the
+        :class:`~consensusml_tpu.obs.costs.ExecutableCost` row.
+        """
+        eng = self
+        if self.config.overlap:
+            eng = ConsensusEngine(
+                dataclasses.replace(
+                    self.config, overlap=False, pipeline_depth=1
+                )
+            )
+        topo = eng.topology
+        w = (
+            simulated.phase_matrices(topo)[0]
+            if topo.is_time_varying
+            else simulated.mixing_matrix(topo)
+        )
+        state = jax.eval_shape(
+            lambda p: eng.init_state(p, world_size=topo.world_size), params
+        )
+        extra_names: list[str] = []
+        extra_args: list[Any] = []
+        if (
+            eng.config.codec_warmup_rounds > 0
+            or eng.config.codec_refresh_every > 0
+        ):
+            extra_names.append("step")
+            extra_args.append(jax.ShapeDtypeStruct((), jnp.int32))
+        comp = eng.config.compressor
+        if comp is not None and comp.stochastic:
+            extra_names.append("rng")
+            extra_args.append(
+                jax.eval_shape(
+                    lambda: jax.vmap(jax.random.key)(
+                        jnp.arange(topo.world_size)
+                    )
+                )
+            )
+
+        def round_fn(p, s, *extra):
+            kw = dict(zip(extra_names, extra))
+            return eng.round_simulated(
+                p, s, w, None, kw.get("rng"), step=kw.get("step")
+            )
+
+        per_worker = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), params
+        )
+        plan = eng.bucket_plan(params, stacked=True)
+        meta = {
+            "topology": type(topo).__name__,
+            "world": topo.world_size,
+            "buckets": plan.num_buckets if plan is not None else 0,
+            "bucket_elems": (
+                [int(b.total) for b in plan.buckets]
+                if plan is not None
+                else []
+            ),
+            "wire_bytes_per_round": eng.wire_bytes_per_round(per_worker),
+            "fused_wire": eng.fused_wire_active,
+            "pipeline_depth": self.config.pipeline_depth,
+            "gossip_steps": eng.config.gossip_steps,
+            "overlap_twin": self.config.overlap,
+        }
+        # round_fn goes in BARE: the ledger jit-wraps it at the AOT
+        # boundary (costs.register), keeping this module free of a jit
+        # entry point that exists only for analysis
+        return ledger.register(
+            name, round_fn, params, state, *extra_args, meta=meta
+        )
+
     def choco_residual(self, state: Any) -> float | None:
         """Host-side CHOCO tracking residual ``||s - xhat||`` from a
         gossip state (ChocoState, or an OverlapState carrying one) —

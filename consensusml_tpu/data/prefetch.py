@@ -83,6 +83,14 @@ _INFLIGHT = get_registry().gauge(
     "consensusml_feed_inflight",
     "staged round batches ready in the prefetch queue (sampled at pop)",
 )
+# the prefetch window's device-byte tag for the live HBM accounting
+# (obs/memviz.py): staged-ahead batches are real resident HBM the
+# three-way reconciliation must be able to name, not anonymous "live"
+_STAGED_BYTES = get_registry().gauge(
+    "consensusml_feed_staged_bytes",
+    "device bytes of round batches staged ahead by the prefetcher "
+    "(queue occupancy x per-batch bytes, sampled at pop)",
+)
 
 
 class FeedItem(NamedTuple):
@@ -174,6 +182,9 @@ class DevicePrefetcher:
         self.stall_seconds_total = 0.0
         self.last_stall_s = 0.0
         self.batches_out = 0
+        # per-batch device bytes (fixed round shape), measured on the
+        # first delivered batch for the staged-bytes HBM tag
+        self._batch_nbytes: int | None = None
         import jax
 
         self._jax = jax
@@ -312,6 +323,12 @@ class DevicePrefetcher:
         _STALL.set(wait)
         _STALL_TOTAL.inc(wait)
         _BATCHES_OUT.inc()
+        if self._batch_nbytes is None:
+            self._batch_nbytes = sum(
+                int(getattr(x, "nbytes", 0))
+                for x in self._jax.tree.leaves(item)
+            )
+        _STAGED_BYTES.set(self._batch_nbytes * (self._queue.qsize() + 1))
         return item
 
     def close(self) -> None:

@@ -26,6 +26,21 @@ The REQUEST plane makes serving explain itself per request
   ``/requests`` (``train.py --metrics-port``,
   ``ServeServer(metrics_port=...)``).
 
+The COST plane attributes time and memory (docs/observability.md "Cost
+attribution", docs/memory.md "Reconciliation"):
+
+- :mod:`~consensusml_tpu.obs.costs` — per-executable compiled
+  cost/memory ledger (``lower().compile()`` FLOPs / bytes-accessed /
+  buffer sizes / compile wall time in ``consensusml_cost_*`` /
+  ``consensusml_compile_*`` families) with roofline expected-vs-measured
+  attribution;
+- :mod:`~consensusml_tpu.obs.memviz` — live HBM accounting
+  (``jax.live_arrays()`` + runtime memory stats) and the three-way
+  analytic / compiled / live reconciliation (``consensusml_hbm_*``);
+- ``GET /profile?ms=N`` on the live HTTP plane — an on-demand
+  ``jax.profiler`` capture of a RUNNING train loop or serving engine
+  (single-flight, bounded dir rotation).
+
 The CLUSTER plane builds on them (docs/observability.md "Cluster view"):
 
 - :mod:`~consensusml_tpu.obs.links` — per-link probes feeding
@@ -50,8 +65,18 @@ from consensusml_tpu.obs.cluster import (  # noqa: F401
     aggregate,
     read_snapshots,
 )
+from consensusml_tpu.obs.costs import (  # noqa: F401
+    CostLedger,
+    ExecutableCost,
+    get_cost_ledger,
+)
 from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
 from consensusml_tpu.obs.httpd import MetricsServer  # noqa: F401
+from consensusml_tpu.obs.memviz import (  # noqa: F401
+    HbmAccountant,
+    compiled_footprint,
+    live_array_bytes,
+)
 from consensusml_tpu.obs.health import (  # noqa: F401
     ConsensusHealthMonitor,
     decay_bound,

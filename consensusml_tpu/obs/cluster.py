@@ -350,6 +350,77 @@ def _round_timeline(ranks: list[dict[str, Any]], max_rounds: int = 64) -> list[d
     return timeline
 
 
+_COST_FAMILIES = {
+    # labeled consensusml_cost_*/compile family -> attribution-row field
+    "consensusml_cost_flops": "flops",
+    "consensusml_cost_bytes_accessed": "bytes_accessed",
+    "consensusml_cost_peak_bytes": "peak_bytes",
+    "consensusml_compile_seconds": "compile_s",
+    "consensusml_cost_expected_seconds": "expected_s",
+    "consensusml_cost_measured_seconds": "measured_s",
+    "consensusml_cost_floor_ratio": "floor_ratio",
+}
+
+
+def _attribution_section(snaps: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Per-executable cost-ledger rows merged across ranks.
+
+    The ledger's gauges are labeled ``executable=``; every rank lowers
+    the same programs, so values merge with max (same convention as the
+    replicated swarm counters). Rows come back sorted by expected cost,
+    costliest first — the render order of obs_report's attribution
+    table. Empty when no rank ran with a cost ledger.
+    """
+    rows: dict[str, dict[str, Any]] = {}
+    for s in snaps:
+        for key, vd in (s.get("metrics") or {}).items():
+            name, labels = parse_metric_key(key)
+            field = _COST_FAMILIES.get(name)
+            if field is None or "executable" not in labels:
+                continue
+            f = _finite(vd)
+            if f is None:
+                continue
+            row = rows.setdefault(
+                labels["executable"], {"executable": labels["executable"]}
+            )
+            row[field] = max(row.get(field, float("-inf")), f)
+    out = list(rows.values())
+    out.sort(key=lambda r: -(r.get("expected_s") or 0.0))
+    return out
+
+
+def _hbm_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """The three-way HBM reconciliation gauges (obs/memviz.py), worst
+    rank per side — plus per-pair drift. None when no rank reconciled."""
+    sides = {
+        "analytic_bytes": "consensusml_hbm_analytic_bytes",
+        "compiled_bytes": "consensusml_hbm_compiled_bytes",
+        "live_peak_bytes": "consensusml_hbm_live_peak_bytes",
+        "live_bytes": "consensusml_hbm_live_bytes",
+    }
+    doc: dict[str, Any] = {}
+    drift: dict[str, float] = {}
+    for s in snaps:
+        for field, fam in sides.items():
+            f = _finite(_metric(s, fam))
+            if f is not None:
+                doc[field] = max(doc.get(field, float("-inf")), f)
+        for key, vd in (s.get("metrics") or {}).items():
+            name, labels = parse_metric_key(key)
+            if name == "consensusml_hbm_drift_pct" and "pair" in labels:
+                f = _finite(vd)
+                if f is not None:
+                    pair = labels["pair"]
+                    # keep the worst-magnitude drift across ranks
+                    if abs(f) >= abs(drift.get(pair, 0.0)):
+                        drift[pair] = f
+    if not doc and not drift:
+        return None
+    doc["drift_pct"] = drift
+    return doc
+
+
 def aggregate(
     cluster_dir: str,
     *,
@@ -650,6 +721,11 @@ def aggregate(
         "requests": _requests_section(ranks + others),
         # cross-rank per-round phase rows from the span digests
         "round_timeline": _round_timeline(ranks),
+        # the cost plane: per-executable compiled cost/attribution rows
+        # + the three-way HBM reconciliation (docs/observability.md
+        # "Cost attribution"; empty/None without --cost-ledger)
+        "attribution": _attribution_section(ranks + others),
+        "hbm": _hbm_section(ranks + others),
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

@@ -1,4 +1,4 @@
-"""Live ``/metrics`` endpoint: scrape the registry over HTTP.
+"""Live HTTP plane: scrape metrics, traces — and capture profiles.
 
 The textfile collector is pull-at-cadence — the file is only as fresh as
 the last ``--telemetry-every`` rewrite, and a serving process with no
@@ -12,7 +12,22 @@ textfile writer uses, freshly rendered per GET, so a Prometheus scraper
   lanes, Perfetto-loadable — the live twin of ``--trace-events``);
 - ``GET /requests`` — the request-trace registry snapshot JSON
   (in-flight + recent completed, docs/observability.md "Request
-  tracing").
+  tracing");
+- ``GET /profile?ms=N`` — an ON-DEMAND ``jax.profiler`` capture of the
+  next N milliseconds of whatever this process is doing (a live train
+  loop, a serving engine mid-traffic) — no restart, no ``--profile-dir``
+  pre-arrangement. The response links the dump through
+  ``tools/xprof_summary.py``'s machine-readable summary when the tool
+  is importable, and always carries the ``*.trace.json.gz`` path so a
+  caller can run ``xprof_summary --json`` itself (docs/perf.md "Live
+  profiling").
+
+``/profile`` is SINGLE-FLIGHT: ``jax.profiler`` supports one session
+per process, so a second request while a capture runs gets **409** with
+the in-flight capture id instead of a corrupted double-start — never
+two overlapping profiler sessions. Capture directories rotate under a
+bounded quota (oldest deleted), so a scraper polling ``/profile`` by
+accident cannot fill the disk.
 
 Surfaces: ``train.py --metrics-port N`` and
 ``ServeServer(metrics_port=N)`` (``0`` picks a free port; read it back
@@ -23,9 +38,15 @@ they already hold for a few µs per update.
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import shutil
+import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry
 from consensusml_tpu.obs.requests import (
@@ -36,6 +57,25 @@ from consensusml_tpu.obs.requests import (
 from consensusml_tpu.obs.tracer import SpanTracer, get_tracer
 
 __all__ = ["MetricsServer"]
+
+PROFILE_MAX_MS = 30_000  # one capture may stall a scraper thread this long
+PROFILE_DEFAULT_MS = 500
+
+
+def _xprof_summary_json(trace_json: str) -> dict | None:
+    """Machine-readable op-family summary via tools/xprof_summary.py
+    (shared by-path loader: obs.memviz.load_tool). None when the tool
+    is absent (installed package without the repo) or the parse fails;
+    the caller still gets the raw trace path either way."""
+    from consensusml_tpu.obs.memviz import load_tool
+
+    try:
+        mod = load_tool("xprof_summary")
+        if mod is None:
+            return None
+        return mod.summarize(trace_json)
+    except Exception:
+        return None
 
 
 class MetricsServer:
@@ -48,14 +88,39 @@ class MetricsServer:
         registry: MetricsRegistry | None = None,
         tracer: SpanTracer | None = None,
         requests: RequestTraceRegistry | None = None,
+        profile_dir: str | None = None,
+        profile_quota: int = 4,
     ):
         registry = registry if registry is not None else get_registry()
         tracer = tracer if tracer is not None else get_tracer()
         requests = requests if requests is not None else get_request_registry()
+        server = self
+
+        # /profile state: one capture at a time, process-wide semantics
+        # (jax.profiler has one global session) but guarded per server —
+        # a second server on the same process still 503s on the double
+        # start rather than corrupting the session.
+        self.profile_dir = profile_dir or os.path.join(
+            tempfile.gettempdir(), f"cml-profiles-{os.getpid()}"
+        )
+        self.profile_quota = max(1, int(profile_quota))
+        self._profile_lock = threading.Lock()
+        self._profile_seq = 0
+        self._profile_inflight: str | None = None
+        self._m_captures = registry.counter(
+            "consensusml_profile_captures_total",
+            "on-demand /profile captures completed",
+        )
+        self._m_prof_rejected = registry.counter(
+            "consensusml_profile_rejected_total",
+            "/profile requests refused (single-flight 409s + profiler "
+            "double-start 503s)",
+        )
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - stdlib API name
-                path = self.path.split("?", 1)[0]
+                url = urlparse(self.path)
+                path = url.path
                 if path in ("/metrics", "/"):
                     body = registry.to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -67,8 +132,19 @@ class MetricsServer:
                 elif path == "/requests":
                     body = json.dumps(requests.snapshot()).encode()
                     ctype = "application/json"
+                elif path == "/profile":
+                    code, doc = server._profile(parse_qs(url.query))
+                    body = json.dumps(doc).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 else:
-                    self.send_error(404, "try /metrics, /traces, /requests")
+                    self.send_error(
+                        404, "try /metrics, /traces, /requests, /profile"
+                    )
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -89,6 +165,90 @@ class MetricsServer:
             daemon=True,
         )
         self._thread.start()
+
+    # -- /profile ---------------------------------------------------------
+
+    def _profile(self, query: dict) -> tuple[int, dict]:
+        """One on-demand capture. Returns (http_status, response_doc).
+
+        Runs ON the scraper's handler thread: the hot paths never wait
+        on it, and the profiler's own overhead is confined to the
+        requested window. The non-blocking lock acquire IS the
+        single-flight guard — the loser reads the winner's capture id.
+        """
+        try:
+            ms = int(query.get("ms", [PROFILE_DEFAULT_MS])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "ms must be an integer"}
+        ms = min(max(ms, 10), PROFILE_MAX_MS)
+
+        if not self._profile_lock.acquire(blocking=False):
+            self._m_prof_rejected.inc()
+            return 409, {
+                "error": "a profile capture is already in flight",
+                "capture_id": self._profile_inflight,
+            }
+        try:
+            import jax
+
+            self._profile_seq += 1
+            cap_id = f"cap-{self._profile_seq:05d}-{int(time.time())}"
+            self._profile_inflight = cap_id
+            cap_dir = os.path.join(self.profile_dir, cap_id)
+            os.makedirs(cap_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(cap_dir)
+            except Exception as e:
+                # a batch --profile-dir window (or another tool) holds
+                # the process's one profiler session
+                self._m_prof_rejected.inc()
+                shutil.rmtree(cap_dir, ignore_errors=True)
+                return 503, {
+                    "error": f"profiler session unavailable: {e}",
+                    "capture_id": None,
+                }
+            try:
+                time.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+            self._rotate_captures()
+            hits = sorted(
+                glob.glob(
+                    os.path.join(cap_dir, "**", "*.trace.json.gz"),
+                    recursive=True,
+                )
+            )
+            trace_json = hits[-1] if hits else None
+            self._m_captures.inc()
+            return 200, {
+                "capture_id": cap_id,
+                "dir": cap_dir,
+                "ms": ms,
+                "trace_json": trace_json,
+                "summary": (
+                    _xprof_summary_json(trace_json) if trace_json else None
+                ),
+            }
+        finally:
+            self._profile_inflight = None
+            self._profile_lock.release()
+
+    def _rotate_captures(self) -> None:
+        """Keep the newest ``profile_quota`` capture dirs (ids sort by
+        sequence, so lexicographic order is capture order)."""
+        try:
+            caps = sorted(
+                d
+                for d in os.listdir(self.profile_dir)
+                if d.startswith("cap-")
+                and os.path.isdir(os.path.join(self.profile_dir, d))
+            )
+        except OSError:
+            return
+        for stale in caps[: -self.profile_quota]:
+            shutil.rmtree(
+                os.path.join(self.profile_dir, stale), ignore_errors=True
+            )
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.address[0]}:{self.port}{path}"

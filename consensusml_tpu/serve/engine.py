@@ -214,6 +214,18 @@ class Engine:
             "consensusml_pool_evictions_total",
             "streams preempted by recompute on block-pool exhaustion",
         )
+        # live HBM tagging (obs/memviz.py): the engine's big resident
+        # consumers as first-class gauges, so per-engine KV headroom is
+        # a signal a fleet router can place traffic on (ROADMAP item 2)
+        # and the three-way reconciliation can attribute serving bytes
+        self._params_nbytes = sum(
+            int(x.nbytes) for x in jax.tree.leaves(self._params)
+        )
+        self._m_params_bytes = reg.gauge(
+            "consensusml_serve_params_bytes",
+            "device bytes of the serving params tree (current generation)",
+        )
+        self._m_params_bytes.set(self._params_nbytes)
         if self.paged:
             self._m_blocks_free = reg.gauge(
                 "consensusml_pool_blocks_free",
@@ -225,6 +237,23 @@ class Engine:
             )
             self._m_blocks_free.set(self._pool.free_blocks)
             self._m_block_occ.set(0.0)
+            pool_bytes = sum(
+                int(x.nbytes) for x in jax.tree.leaves(self._pages)
+            )
+            self._block_nbytes = pool_bytes // max(self._pool.num_blocks, 1)
+            self._m_pool_hbm = reg.gauge(
+                "consensusml_pool_hbm_bytes",
+                "device bytes held by the paged KV block pool (all layers)",
+            )
+            self._m_pool_hbm.set(pool_bytes)
+            self._m_pool_hbm_free = reg.gauge(
+                "consensusml_pool_hbm_free_bytes",
+                "KV bytes still allocatable (free blocks x per-block "
+                "bytes) — the per-engine serving HBM headroom signal",
+            )
+            self._m_pool_hbm_free.set(
+                self._pool.free_blocks * self._block_nbytes
+            )
 
         # host-side SLO accumulators for bench/loadgen percentiles —
         # BOUNDED rings (a serving process lives for weeks; the Prometheus
@@ -419,6 +448,10 @@ class Engine:
             return  # re-export must be stageable
         self._params = sw.params
         self._generation = sw.generation
+        self._params_nbytes = sum(
+            int(x.nbytes) for x in jax.tree.leaves(sw.params)
+        )
+        self._m_params_bytes.set(self._params_nbytes)
         for _i, slot in self._table.active:
             slot.generation = sw.generation
             # a mid-stream generation flip is part of the request's
@@ -442,6 +475,87 @@ class Engine:
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if size is not None else -1
         return out
+
+    def register_costs(self, ledger: Any = None) -> dict[str, Any]:
+        """Register every serving executable in the cost ledger
+        (:mod:`consensusml_tpu.obs.costs`): one prefill row per prompt
+        bucket, the one decode row, and the hot-swap staging transfer.
+
+        AOT-lowers with shape structs mirroring the live call shapes —
+        nothing executes, no cache is mutated, and the zero-recompile
+        contract's :meth:`compile_counts` is byte-identical before and
+        after (pinned by ``pytest -m profiling``). The price is one
+        duplicate compile per executable on the caller's thread, so run
+        it alongside :meth:`warmup`, not per request. Returns
+        ``{name: ExecutableCost}``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if ledger is None:
+            from consensusml_tpu.obs import get_cost_ledger
+
+            ledger = get_cost_ledger()
+        st = lambda t: jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t
+        )
+        params = st(self._params)
+        rows: dict[str, Any] = {}
+        base_meta = {
+            "kv_impl": self.config.kv_impl,
+            "num_slots": self.config.num_slots,
+            "max_len": self.max_len,
+        }
+        if self.paged:
+            from consensusml_tpu.serve.pool.stages import (
+                decode_cost_args,
+                prefill_cost_args,
+            )
+
+            pages = st(self._pages)
+            bs = self.config.block_size
+            for b in self.buckets:
+                name = f"serve.prefill.b{b}"
+                rows[name] = ledger.register(
+                    name, self._prefill_fn, params, pages,
+                    *prefill_cost_args(b, bs),
+                    meta={**base_meta, "bucket": b, "block_size": bs},
+                )
+            rows["serve.decode"] = ledger.register(
+                "serve.decode", self._decode_fn, params, pages,
+                *decode_cost_args(
+                    self.config.num_slots, self._pool.blocks_per_slot
+                ),
+                meta={
+                    **base_meta,
+                    "num_blocks": self._pool.num_blocks,
+                    "block_size": bs,
+                },
+            )
+        else:
+            cache = st(self._cache)
+            for b in self.buckets:
+                name = f"serve.prefill.b{b}"
+                rows[name] = ledger.register(
+                    name, self._prefill_fn, params, cache,
+                    jax.ShapeDtypeStruct((1, b), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    meta={**base_meta, "bucket": b},
+                )
+            toks = jax.ShapeDtypeStruct((self.config.num_slots,), jnp.int32)
+            rows["serve.decode"] = ledger.register(
+                "serve.decode", self._decode_fn, params, cache, toks, toks,
+                meta=base_meta,
+            )
+        # the hot-swap stage is a transfer, not a program: restore +
+        # device_put of one params tree on the watcher thread
+        rows["serve.hotswap.stage"] = ledger.register_transfer(
+            "serve.hotswap.stage", self._params,
+            meta={**base_meta, "generation": self._generation},
+        )
+        self._cost_ledger = ledger
+        return rows
 
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admitting; serve everything queued + in flight to
@@ -823,6 +937,9 @@ class Engine:
             self._block_occupancy_sum += occ
             self._m_block_occ.set(occ)
             self._m_blocks_free.set(self._pool.free_blocks)
+            self._m_pool_hbm_free.set(
+                self._pool.free_blocks * self._block_nbytes
+            )
         # one lock round-trip covers every resident slot's tick
         self._rt.decode_ticks(
             [self._rid(slot.request) for _i, slot in active]

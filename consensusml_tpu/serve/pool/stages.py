@@ -33,8 +33,40 @@ from typing import Any, Callable
 __all__ = [
     "make_paged_prefill_fn",
     "make_paged_decode_fn",
+    "prefill_cost_args",
+    "decode_cost_args",
     "AdmissionScheduler",
 ]
+
+
+def prefill_cost_args(bucket: int, block_size: int) -> tuple:
+    """Abstract non-tree arguments of one paged-prefill invocation at
+    ``bucket`` tokens — ``(ids, length, block_row)`` shape structs for
+    the cost ledger's AOT lowering (``Engine.register_costs``). Shapes
+    mirror exactly what the live path passes, so the ledger's compiled
+    row IS the serving executable's cost, not a lookalike's."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((bucket // block_size,), jnp.int32),
+    )
+
+
+def decode_cost_args(num_slots: int, blocks_per_slot: int) -> tuple:
+    """Abstract ``(block_table, tokens, positions)`` shape structs of
+    the ONE paged-decode executable (every occupancy/length mix runs
+    this same program — one ledger row covers all of serving decode)."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((num_slots, blocks_per_slot), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+    )
 
 
 def make_paged_prefill_fn(dm: Any) -> Callable:

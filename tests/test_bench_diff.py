@@ -35,6 +35,40 @@ def test_default_spec_is_well_formed():
     keys = {e["key"] for e in mod.DEFAULT_SPEC}
     assert "observability.link_probe_overhead_pct" in keys
     assert "observability.request_tracing_overhead_pct" in keys
+    # the cost-attribution plane (ISSUE 11): run-time overhead budget,
+    # per-executable compile budgets, and the every-workload
+    # expected-vs-measured presence gate
+    assert "attribution.attribution_overhead_pct" in keys
+    assert "attribution.expected_vs_measured_missing" in keys
+    for exe in ("train_step", "gossip_round", "serve_decode",
+                "serve_prefill_max"):
+        assert f"attribution.compile_ms.{exe}" in keys
+
+
+def test_attribution_budgets_enforced_on_fresh_result(tmp_path, capsys):
+    """A fresh bench whose attribution section blows the run-time
+    budget or misses an expected-vs-measured pairing fails the gate."""
+    mod = _tool()
+    fresh = {
+        "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+        "attribution": {
+            "attribution_overhead_pct": 3.0,  # budget is <1%
+            "expected_vs_measured_missing": 1,  # must be 0
+            "compile_ms": {"train_step": 500.0},
+        },
+    }
+    path = tmp_path / "fresh.json"
+    path.write_text(json.dumps(fresh))
+    rc = mod.main([str(path), "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "attribution.attribution_overhead_pct" in failed
+    assert "attribution.expected_vs_measured_missing" in failed
+    ok = {
+        r["key"]: r["status"] for r in doc["rows"]
+    }
+    assert ok["attribution.compile_ms.train_step"] == "ok"
 
 
 def test_runs_clean_against_checked_in_trajectory(capsys):

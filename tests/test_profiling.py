@@ -8,8 +8,11 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from consensusml_tpu.utils import RoundTimer, annotate, fence, trace
+
+pytestmark = pytest.mark.profiling
 
 
 def test_round_timer_separates_warmup_and_steady_state():

@@ -63,6 +63,24 @@ DEFAULT_SPEC = [
      "bound": 1.0},
     {"key": "observability.request_tracing_overhead_pct",
      "direction": "max", "bound": 1.0},
+    # cost-attribution plane (docs/observability.md "Cost attribution"):
+    # the run-time side must stay under 1% of a round, the ledger's
+    # per-executable compile budgets are ABSOLUTE walls (CPU-tier tiny
+    # models; a blowup here means a program family regressed its
+    # lowering, not that the box was busy), and every bench workload
+    # must carry an expected-vs-measured pairing — zero missing
+    {"key": "attribution.attribution_overhead_pct", "direction": "max",
+     "bound": 1.0},
+    {"key": "attribution.expected_vs_measured_missing", "direction": "max",
+     "bound": 0.0},
+    {"key": "attribution.compile_ms.train_step", "direction": "max",
+     "bound": 60000.0},
+    {"key": "attribution.compile_ms.gossip_round", "direction": "max",
+     "bound": 60000.0},
+    {"key": "attribution.compile_ms.serve_decode", "direction": "max",
+     "bound": 60000.0},
+    {"key": "attribution.compile_ms.serve_prefill_max", "direction": "max",
+     "bound": 60000.0},
 ]
 
 

@@ -331,13 +331,11 @@ def measure(name: str, scale: str, rounds: int = 2) -> dict:
     batch = next(iter(bundle.batches(1, 0)))
     ma = step.lower(state, batch).compile().memory_analysis()
     # donated state aliases its outputs, so arguments+temps IS the live
-    # footprint; alias_size is subtracted to avoid double-counting
-    compiled_peak = (
-        ma.argument_size_in_bytes
-        + ma.temp_size_in_bytes
-        + ma.output_size_in_bytes
-        - ma.alias_size_in_bytes
-    )
+    # footprint — the ONE definition shared with the cost ledger and
+    # the three-way reconciliation (obs/memviz.compiled_footprint)
+    from consensusml_tpu.obs.memviz import compiled_footprint
+
+    compiled_peak = compiled_footprint(ma)
     out = {
         "platform": jax.default_backend(),
         "argument_bytes": ma.argument_size_in_bytes,
@@ -370,13 +368,34 @@ _ALL = [
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
-    ap.add_argument("--scale", default="full", choices=("smoke", "full"))
+    # default resolved after parse: "full" for the analytic paths, but
+    # "smoke" under --reconcile, which actually COMPILES AND RUNS the
+    # config on this box — full-scale llama/gpt2 would OOM a dev host
+    ap.add_argument("--scale", default=None, choices=("smoke", "full"))
     ap.add_argument("--world", type=int, default=None)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--measure", action="store_true",
                     help="also run world=1 on this device and report peak")
+    ap.add_argument("--reconcile", action="store_true",
+                    help="run the three-way reconciliation (analytic vs "
+                         "compiled memory_analysis vs live peak) through "
+                         "obs/memviz.reconcile_config and print its doc — "
+                         "the drift gauges a live run exports under "
+                         "consensusml_hbm_* (docs/memory.md "
+                         "'Reconciliation')")
     ap.add_argument("--md", action="store_true")
     args = ap.parse_args()
+    if args.scale is None:
+        args.scale = "smoke" if args.reconcile else "full"
+
+    if args.reconcile:
+        if not args.config:
+            ap.error("--reconcile needs --config NAME")
+        from consensusml_tpu.obs.memviz import reconcile_config
+
+        doc = reconcile_config(args.config, args.scale)
+        print(json.dumps(doc, indent=2))
+        return
 
     runs = (
         _ALL

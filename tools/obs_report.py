@@ -53,6 +53,16 @@ def _fmt_b(v) -> str:
     return f"{v:.0f}B"
 
 
+def _fmt_count(v) -> str:
+    """Compact count (FLOPs): 2.5G, 57M, 1.6K."""
+    if v is None or (isinstance(v, float) and not math.isfinite(v)):
+        return "-"
+    for unit, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= scale:
+            return f"{v / scale:.1f}{unit}"
+    return f"{v:.0f}"
+
+
 def render_text(doc: dict) -> str:
     lines: list[str] = []
     add = lines.append
@@ -193,6 +203,40 @@ def render_text(doc: dict) -> str:
                     f"-> {st['phase']} ({', '.join(parts)})"
                 )
             add(f"  {row['round']:>5}  {ranks}{extra}")
+    attribution = doc.get("attribution") or []
+    if attribution:
+        add("")
+        add("cost attribution (compiled cost ledger, per executable):")
+        add("  executable              flops      bytes      compile   expected   measured   x-floor")
+        for r in attribution:
+            xf = r.get("floor_ratio")
+            add(
+                f"  {r['executable']:<22} "
+                f"{_fmt_count(r.get('flops')):>8}  "
+                f"{_fmt_b(r.get('bytes_accessed')):>9}  "
+                f"{_fmt_s(r.get('compile_s')):>8}  "
+                f"{_fmt_s(r.get('expected_s')):>9}  "
+                f"{_fmt_s(r.get('measured_s')):>9}  "
+                f"{'-' if xf is None else format(xf, '.1f'):>7}"
+            )
+    hbm = doc.get("hbm")
+    if hbm:
+        drift = hbm.get("drift_pct") or {}
+        add(
+            "hbm reconciliation: analytic "
+            f"{_fmt_b(hbm.get('analytic_bytes'))} vs compiled "
+            f"{_fmt_b(hbm.get('compiled_bytes'))} vs live "
+            f"{_fmt_b(hbm.get('live_peak_bytes'))}"
+            + (
+                " ("
+                + ", ".join(
+                    f"{k} {v:+.1f}%" for k, v in sorted(drift.items())
+                )
+                + ")"
+                if drift
+                else ""
+            )
+        )
     if doc["flight_recorders"]:
         add("flight recorders:")
         for fr in doc["flight_recorders"]:

@@ -8,8 +8,18 @@ that produced docs/perf.md's tables:
     python train.py --config cifar_resnet50 --profile-dir /tmp/prof ...
     python tools/xprof_summary.py /tmp/prof
 
-Groups device ops by fused-op family (trailing .N stripped) and reports
-total/share, plus the host-side top-level spans for context.
+Groups device ops by fused-op family and reports total/share, plus the
+host-side top-level spans for context. Family grouping strips XLA's
+duplicate-instruction suffix (``fusion`` / ``fusion.1`` / ``fusion.2``
+merge) but ONLY when the bare base name also appears in the trace — a
+pallas kernel whose family name itself ends in ``.N`` (two fused-wire
+codecs differing only by a numeric width suffix) has no bare sibling
+and stays its own row instead of silently merging with its neighbor.
+
+``--json`` emits the whole report as one machine-readable document
+(op-family table, totals, host spans) so the bench, the cost ledger's
+``/profile`` endpoint, and scripts can consume captures
+programmatically instead of scraping the text table.
 
 With ``--host-trace trace.json`` (the Chrome trace-event file
 ``train.py --trace-events`` writes — see docs/observability.md) the
@@ -39,6 +49,23 @@ def find_trace_json(root: str) -> str | None:
     return hits[-1] if hits else None
 
 
+def op_family(name: str, raw_names: set[str]) -> str:
+    """Family an op name groups under.
+
+    XLA uniquifies duplicated instructions as ``base.1``, ``base.2``, …
+    ALONGSIDE the bare ``base`` — so a trailing ``.N`` is stripped only
+    when that bare base is itself present in the trace. A name whose
+    family genuinely ends in a number after a dot (distinct pallas
+    kernels differing only by a numeric suffix, e.g. a ``.4``/``.8``
+    bit-width pair) has no bare sibling and keeps its full name — the
+    old unconditional strip merged such pairs into one bogus row.
+    """
+    m = re.match(r"^(.*)\.(\d+)$", name)
+    if m and m.group(1) in raw_names:
+        return m.group(1)
+    return name
+
+
 def summarize(path: str, top: int = 25) -> dict:
     with gzip.open(path) as f:
         data = json.load(f)
@@ -52,17 +79,26 @@ def summarize(path: str, top: int = 25) -> dict:
     is_wrapper = lambda n: (
         n in ("0",) or n.startswith("jit_") or n.startswith("while")
     )
-    cat: Counter = Counter()
+    raw: Counter = Counter()
+    event_count = 0
     for e in ev:
+        if e.get("ph") == "X":
+            event_count += 1
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
             continue
         if is_wrapper(e["name"]):
             continue
-        cat[re.sub(r"\.\d+$", "", e["name"])] += e.get("dur", 0)
+        raw[e["name"]] += e.get("dur", 0)
+    raw_names = set(raw)
+    cat: Counter = Counter()
+    for name, d in raw.items():
+        cat[op_family(name, raw_names)] += d
     total = sum(cat.values())
     return {
         "trace": path,
         "device_total_ms": round(total / 1000, 2),
+        "event_count": event_count,
+        "processes": {str(p): n for p, n in sorted(names.items())},
         "ops": [
             {
                 "op": name,
@@ -114,6 +150,11 @@ def main() -> int:
                    help="Chrome trace-event JSON from train.py "
                         "--trace-events; its host spans are merged into "
                         "the report")
+    p.add_argument("--json", action="store_true",
+                   help="emit ONE machine-readable JSON document (op "
+                        "table + totals + host spans) instead of the "
+                        "text report — what bench/the cost ledger and "
+                        "the /profile endpoint consume")
     args = p.parse_args()
 
     root = args.trace_dir
@@ -135,11 +176,7 @@ def main() -> int:
         )
         return 1
     out = summarize(path)
-    print(f"trace: {out['trace']}")
-    print(f"device op total: {out['device_total_ms']} ms")
-    for o in out["ops"]:
-        print(f"{o['ms']:10.2f} ms  {100 * o['share']:5.1f}%  {o['op']}")
-
+    spans = None
     if args.host_trace:
         if not os.path.exists(args.host_trace):
             print(
@@ -157,6 +194,18 @@ def main() -> int:
                 file=sys.stderr,
             )
             return 1
+
+    if args.json:
+        if spans is not None:
+            out["host_spans"] = spans
+        print(json.dumps(out, indent=2))
+        return 0
+
+    print(f"trace: {out['trace']}")
+    print(f"device op total: {out['device_total_ms']} ms")
+    for o in out["ops"]:
+        print(f"{o['ms']:10.2f} ms  {100 * o['share']:5.1f}%  {o['op']}")
+    if spans is not None:
         print(f"\nhost spans: {args.host_trace}")
         for s in spans:
             print(

@@ -195,7 +195,20 @@ def parse_args(argv=None):
                         "scrape (same locked expose() path as "
                         "--metrics-prom), /traces the merged Chrome trace, "
                         "/requests the request-trace registry snapshot "
-                        "(docs/observability.md 'Request tracing')")
+                        "(docs/observability.md 'Request tracing'), and "
+                        "/profile?ms=N an on-demand jax.profiler capture of "
+                        "the LIVE loop (single-flight; docs/perf.md)")
+    p.add_argument("--cost-ledger", action="store_true",
+                   help="register the run's executables (train step, gossip "
+                        "round under its bucket plan) in the compiled cost "
+                        "ledger: lower().compile() cost/memory analysis + "
+                        "compile wall time per executable into the "
+                        "consensusml_cost_*/consensusml_compile_* families, "
+                        "live HBM gauges at --telemetry-every cadence, and "
+                        "the three-way analytic/compiled/live HBM drift "
+                        "(docs/observability.md 'Cost attribution'; costs "
+                        "ONE duplicate XLA compile per executable at round "
+                        "0 — analysis only, jit caches untouched)")
     p.add_argument("--telemetry-every", type=int, default=10, metavar="N",
                    help="cadence (rounds) for the heavier telemetry: metric "
                         "snapshots, Prometheus rewrite, and the CHOCO "
@@ -774,6 +787,7 @@ def main(argv=None) -> int:
         or args.flight_recorder
         or args.obs_cluster_dir
         or args.link_probes
+        or args.cost_ledger
         or args.metrics_port is not None
     )
     if telemetry_on:
@@ -1240,12 +1254,54 @@ def _train_loop(
         )
         print(f"cluster snapshots: {cluster.path}", flush=True)
 
+    # ---- compiled cost ledger + live HBM accounting (obs.costs/memviz) --
+    ledger = accountant = None
+    if args.cost_ledger:
+        from consensusml_tpu.obs import HbmAccountant, get_cost_ledger
+
+        ledger = get_cost_ledger()
+        accountant = HbmAccountant(registry=registry)
+
+    def register_run_costs(state, batch):
+        """Round-0 ledger registration (state/batch templates exist,
+        nothing has compiled yet): the full train-step executable, and
+        — on the simulated backend, whose transport program is the one
+        round_simulated lowers — the gossip round under its bucket
+        plan. AOT analysis only; the step's own first-call compile is
+        untouched (the duplicate compile is this flag's documented
+        cost)."""
+        row = ledger.register("train.step", step, state, batch)
+        print(
+            f"cost ledger: train.step {row.flops:.3g} flops "
+            f"{row.bytes_accessed:.3g} B accessed, compile "
+            f"{row.compile_s * 1e3:.0f} ms",
+            flush=True,
+        )
+        if backend == "simulated":
+            gossiped = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                {"params": state.params, "model_state": state.model_state},
+            )
+            grow = engine.register_costs(ledger, gossiped)
+            print(
+                f"cost ledger: gossip.round {grow.flops:.3g} flops, "
+                f"{grow.meta['buckets']} bucket(s), compile "
+                f"{grow.compile_s * 1e3:.0f} ms",
+                flush=True,
+            )
+
     def telemetry_tick(rnd, state):
         """The heavier sampled telemetry (--telemetry-every cadence):
         link probes, CHOCO residual fetch, metric snapshot, Prometheus
         rewrite, cluster snapshot."""
         if prober is not None:
             prober.probe_round()
+        if accountant is not None:
+            accountant.tick()  # live HBM gauges (host bookkeeping only)
+        if ledger is not None and ledger.row("train.step") is not None:
+            # pair the steady-state measured round with the compiled
+            # cost row -> expected-vs-measured attribution gauges
+            ledger.observe_measured("train.step", timer.last_lap_s)
         resid = engine.choco_residual(state.gossip)
         if resid is not None:
             registry.gauge(
@@ -1374,6 +1430,16 @@ def _train_loop(
                 if batch_shardings is None:
                     batch_shardings = wmesh.stacked_shardings(batch)
                 batch = wmesh.shard_stacked(batch, shardings=batch_shardings)
+            if ledger is not None and i == 0:
+                try:
+                    register_run_costs(state, batch)
+                except Exception as e:  # analysis must never kill a run
+                    print(
+                        f"cost ledger: registration failed "
+                        f"({type(e).__name__}: {e}); continuing without",
+                        flush=True,
+                    )
+                    ledger = None
             if args.profile_dir and i == 2:
                 profiling = profile_trace(args.profile_dir)
                 profiling.__enter__()
@@ -1502,6 +1568,57 @@ def _train_loop(
         print(f"checkpoint: {saver.last_path}", flush=True)
     if args.export_serving and last_exported != start + args.rounds:
         export_art(state, start + args.rounds)
+    if ledger is not None and accountant is not None and metrics:
+        # end-of-run expected-vs-measured attribution + the three-way
+        # HBM reconciliation (docs/memory.md "Reconciliation") — BEFORE
+        # the final telemetry tick so the last cluster snapshot carries
+        # the reconciled gauges
+        if ledger.row("train.step") is not None:
+            attr = ledger.observe_measured(
+                "train.step", timer.stats().p50_s
+            )
+            print(
+                "cost attribution: train.step measured "
+                f"{1e3 * attr['measured_s']:.1f} ms vs {attr['bound']}-"
+                f"bound floor {1e3 * attr['expected_s']:.2f} ms "
+                f"({attr['ratio_to_floor']:.1f}x)",
+                flush=True,
+            )
+        analytic = None
+        try:
+            from consensusml_tpu.obs.memviz import _load_hbm_model
+
+            hm = _load_hbm_model()
+            if hm is not None:
+                pred = hm.predict(
+                    bundle.name, scale, world=bundle.world_size
+                )
+                analytic = float(pred["predicted_peak_bytes"])
+                if backend == "simulated":
+                    # predict() models ONE worker's device; the simulated
+                    # backend stacks every worker on this one device
+                    analytic *= bundle.world_size
+        except Exception as e:
+            print(f"hbm reconciliation: no analytic side ({e})", flush=True)
+        row = ledger.row("train.step")
+        # a run shorter than --telemetry-every has no in-loop sample
+        # yet; without this tick the live side would be a fake zero
+        accountant.tick()
+        rec = accountant.reconcile(
+            analytic_bytes=analytic,
+            compiled_bytes=float(row.peak_bytes) if row else None,
+        )
+        drift = ", ".join(
+            f"{k} {v:+.1f}%" for k, v in sorted(rec["drift_pct"].items())
+        )
+        print(
+            "hbm reconciliation: analytic "
+            f"{(rec['analytic_bytes'] or 0) / 1e6:.1f} MB vs compiled "
+            f"{(rec['compiled_bytes'] or 0) / 1e6:.1f} MB vs live "
+            f"{(rec['live_peak_bytes'] or 0) / 1e6:.1f} MB"
+            + (f" ({drift})" if drift else ""),
+            flush=True,
+        )
     if (
         telemetry_on
         and metrics
