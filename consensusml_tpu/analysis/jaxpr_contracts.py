@@ -482,18 +482,22 @@ def _check_decode_jaxpr(name: str, bundle) -> list[Finding]:
     cache = jax.eval_shape(lambda: D.init_cache(dm, slots, max_len))
     tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
     positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    samp = _sampling_structs(slots)
     decode = D.make_decode_fn(dm)
-    closed = jax.make_jaxpr(decode)(params, cache, tokens, positions)
+    closed = jax.make_jaxpr(decode)(params, cache, tokens, positions, *samp)
     findings = _callback_f64_findings(closed, mk, "decode step")
 
     # recompile contract: step r's OUTPUT cache, fed back as step r+1's
     # input (exactly what the engine loop does every token), must trace
     # to the byte-identical program — zero recompiles across decode
-    # steps at ANY slot occupancy / length mix (fill level is data)
-    out_tokens, out_cache = jax.eval_shape(decode, params, cache, tokens, positions)
+    # steps at ANY slot occupancy / length / greedy-vs-sampled mix
+    # (fill level AND sampling parameters are data)
+    out_tokens, out_cache = jax.eval_shape(
+        decode, params, cache, tokens, positions, *samp
+    )
     findings += _hash_stable(
         mk, decode, closed,
-        (params, out_cache, out_tokens, positions),
+        (params, out_cache, out_tokens, positions, *samp),
         "decode", "signature-hash",
     )
     findings += _cache_drift(
@@ -501,6 +505,20 @@ def _check_decode_jaxpr(name: str, bundle) -> list[Finding]:
         "donation and the jit cache both break",
     )
     return findings
+
+
+def _sampling_structs(slots: int | None = None):
+    """Abstract per-slot sampling triple ``(temperature, top_p, seeds)``
+    — scalars when ``slots`` is None (the prefill signature)."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = () if slots is None else (slots,)
+    return (
+        jax.ShapeDtypeStruct(shape, jnp.float32),
+        jax.ShapeDtypeStruct(shape, jnp.float32),
+        jax.ShapeDtypeStruct(shape, jnp.uint32),
+    )
 
 
 def _hash_stable(mk, fn, closed, out_args, what: str, detail: str) -> list[Finding]:
@@ -596,14 +614,17 @@ def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
     ids = jax.ShapeDtypeStruct((1, max_len), jnp.int32)
     length = jax.ShapeDtypeStruct((), jnp.int32)
     block_row = jax.ShapeDtypeStruct((blocks_per_slot,), jnp.int32)
-    closed = jax.make_jaxpr(prefill)(params, pages, ids, length, block_row)
+    samp1 = _sampling_structs(None)
+    closed = jax.make_jaxpr(prefill)(
+        params, pages, ids, length, block_row, *samp1
+    )
     findings += _callback_f64_findings(closed, mkp, "paged prefill stage")
     _tok, _logits, prefill_pages = jax.eval_shape(
-        prefill, params, pages, ids, length, block_row
+        prefill, params, pages, ids, length, block_row, *samp1
     )
     findings += _hash_stable(
         mkp, prefill, closed,
-        (params, prefill_pages, ids, length, block_row),
+        (params, prefill_pages, ids, length, block_row, *samp1),
         "paged prefill", "signature-hash",
     )
 
@@ -615,14 +636,17 @@ def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
     table = jax.ShapeDtypeStruct((slots, blocks_per_slot), jnp.int32)
     tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
     positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
-    closed = jax.make_jaxpr(decode)(params, pages, table, tokens, positions)
+    samp = _sampling_structs(slots)
+    closed = jax.make_jaxpr(decode)(
+        params, pages, table, tokens, positions, *samp
+    )
     findings += _callback_f64_findings(closed, mkd, "paged decode stage")
     out_tokens, out_pages = jax.eval_shape(
-        decode, params, pages, table, tokens, positions
+        decode, params, pages, table, tokens, positions, *samp
     )
     findings += _hash_stable(
         mkd, decode, closed,
-        (params, out_pages, table, out_tokens, positions),
+        (params, out_pages, table, out_tokens, positions, *samp),
         "paged decode", "signature-hash",
     )
     for stage, mk, out in (
@@ -638,9 +662,98 @@ def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
     return findings
 
 
+def _check_spec_stage_jaxprs(name: str, bundle) -> list[Finding]:
+    """Speculative-decode stage contracts (causal-LM configs only).
+
+    The spec pipeline (``serve/pool/spec.py``) adds TWO executables —
+    the draft's k-step propose scan and the target's one fused k-verify
+    — and each carries the full contract set independently: no host
+    callbacks anywhere (the per-slot PRNG fold, the acceptance uniforms,
+    and the residual re-draw all live in-trace), no f64/complex128 (the
+    distributions are explicit f32), and the step-over-step canonical
+    hash stable — propose's output draft pages feed the next propose,
+    verify's output target pages feed the next verify, so the engine's
+    zero-recompile contract extends to every sampled speculative tick.
+    Traced with the config's own model standing in as its draft (the
+    contracts pin program SHAPE; the engine accepts any same-vocab
+    draft)."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve import decode as D
+    from consensusml_tpu.serve import pool as P
+
+    if bundle.model is None or not D.supports_decode(bundle.model):
+        return []
+    findings: list[Finding] = []
+    dm = D.DecodeModel.wrap(bundle.model)
+    slots, max_len, bs, k = 4, min(dm.max_len, 32), 8, 2
+    blocks_per_slot = max_len // bs
+    cols = P.spec_table_cols(blocks_per_slot, bs, k)
+    num_blocks = slots * blocks_per_slot + 1
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    params = probe[0] if isinstance(probe, tuple) and len(probe) == 2 else probe
+    pages = jax.eval_shape(lambda: P.init_pages(dm, num_blocks, bs))
+    table = jax.ShapeDtypeStruct((slots, cols), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    samp = _sampling_structs(slots)
+
+    # -- draft propose scan ------------------------------------------------
+    mkp = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "spec_propose", detail, msg
+    )
+    propose = P.make_draft_propose_fn(dm, k)
+    closed = jax.make_jaxpr(propose)(
+        params, pages, table, tokens, positions, *samp
+    )
+    findings += _callback_f64_findings(closed, mkp, "spec propose stage")
+    props, q_sel, q_probs, out_dpages = jax.eval_shape(
+        propose, params, pages, table, tokens, positions, *samp
+    )
+    findings += _hash_stable(
+        mkp, propose, closed,
+        (params, out_dpages, table, tokens, positions, *samp),
+        "spec propose", "signature-hash",
+    )
+
+    # -- fused k-verify ----------------------------------------------------
+    mkv = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "spec_verify", detail, msg
+    )
+    verify = P.make_verify_fn(dm, k)
+    closed = jax.make_jaxpr(verify)(
+        params, pages, table, tokens, props, q_sel, q_probs, positions,
+        *samp,
+    )
+    findings += _callback_f64_findings(closed, mkv, "spec verify stage")
+    _n, _y, out_pages = jax.eval_shape(
+        verify, params, pages, table, tokens, props, q_sel, q_probs,
+        positions, *samp,
+    )
+    findings += _hash_stable(
+        mkv, verify, closed,
+        (params, out_pages, table, tokens, props, q_sel, q_probs,
+         positions, *samp),
+        "spec verify", "signature-hash",
+    )
+    for stage, mk, out in (
+        ("propose", mkp, out_dpages),
+        ("verify", mkv, out_pages),
+    ):
+        findings += _cache_drift(
+            mk, pages, out, f"the spec {stage} stage's page pytree",
+            "pages-drift",
+            "the pool is one fixed allocation for the engine's life — "
+            "donation and the jit cache both break",
+        )
+    return findings
+
+
 def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
-    """All jaxpr contracts for one config (incl. the serving decode step
-    and BOTH paged serving stages on causal-LM configs)."""
+    """All jaxpr contracts for one config (incl. the serving decode
+    step, BOTH paged serving stages, and the speculative propose/verify
+    pair on causal-LM configs)."""
     from consensusml_tpu import configs
 
     bundle = configs.build(name, scale=scale)
@@ -648,6 +761,7 @@ def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
     findings.extend(_check_collective_count(name, bundle))
     findings.extend(_check_decode_jaxpr(name, bundle))
     findings.extend(_check_paged_stage_jaxprs(name, bundle))
+    findings.extend(_check_spec_stage_jaxprs(name, bundle))
     return findings
 
 

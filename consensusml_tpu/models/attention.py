@@ -18,8 +18,10 @@ __all__ = [
     "dot_product_attention",
     "blockwise_attention",
     "cached_attention",
+    "cached_attention_window",
     "update_kv_cache",
     "paged_update_kv_cache",
+    "paged_update_kv_cache_window",
     "gather_paged_kv",
     "apply_rope",
     "rope_frequencies",
@@ -289,6 +291,37 @@ def paged_update_kv_cache(
     return k_pages, v_pages, positions + 1
 
 
+def paged_update_kv_cache_window(
+    cache: dict[str, jax.Array],
+    k: jax.Array,  # (S, W, H, D) — a W-token verify window per slot
+    v: jax.Array,  # (S, W, H, D)
+    block_table: jax.Array,  # (S, cols) physical block ids (trash-padded)
+    positions: jax.Array,  # (S, W) per-slot, per-window-token index
+) -> tuple[jax.Array, jax.Array]:
+    """Write a ``W``-token window of K/V into the paged pool — the
+    speculative k-verify's fixed-shape widening of
+    :func:`paged_update_kv_cache` (``W = k + 1``: the pending token plus
+    k draft proposals, all scattered in ONE step).
+
+    Index math is the single-token scatter's, per window column:
+    ``physical = block_table[s, p // bs]``, ``offset = p % bs`` — all on
+    device, zero host sync. Window positions that run past a slot's real
+    block-table row (a stream within ``k`` of ``max_len``) index the
+    TRASH-padded columns the engine appends in speculative mode, so
+    overflow writes land in the trash block, never in pages another slot
+    owns. Rejected draft positions are *not* rolled back here: their
+    rows sit beyond the slot's committed length, the length mask zeroes
+    them exactly, and the next verify window overwrites them — rollback
+    is pure host-side position/block accounting.
+    """
+    bs = cache["k"].shape[1]
+    phys = jnp.take_along_axis(block_table, positions // bs, axis=1)
+    off = positions % bs
+    k_pages = cache["k"].at[phys, off].set(jnp.asarray(k, cache["k"].dtype))
+    v_pages = cache["v"].at[phys, off].set(jnp.asarray(v, cache["v"].dtype))
+    return k_pages, v_pages
+
+
 def gather_paged_kv(
     k_pages: jax.Array,  # (N, bs, H, D)
     v_pages: jax.Array,  # (N, bs, H, D)
@@ -339,6 +372,35 @@ def cached_attention(
     kv_mask = jnp.arange(t)[None, :] < lengths[:, None]
     return dot_product_attention(
         q, k_cache, v_cache, kv_mask=kv_mask, dtype=dtype, impl="dense"
+    )
+
+
+def cached_attention_window(
+    q: jax.Array,  # (B, W, H, D) — W query tokens per slot
+    k_cache: jax.Array,  # (B, T, H, D)
+    v_cache: jax.Array,  # (B, T, H, D)
+    *,
+    positions: jax.Array,  # (B, W) absolute position of each query token
+    dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Multi-query-token decode attention — :func:`cached_attention`
+    widened to a ``W``-token window (the speculative verify step).
+
+    Query token ``w`` of slot ``b`` sits at absolute position
+    ``positions[b, w]`` and attends cache rows ``<= positions[b, w]`` —
+    its own just-written row included, everything later masked. That one
+    per-row mask encodes BOTH causality inside the window (window tokens
+    are written to the cache before the gather, and a later window
+    token's position exceeds an earlier one's) and the stale-garbage
+    exclusion past each slot's length, so no separate causal matrix is
+    needed. ``W = 1`` with ``positions[:, None]`` degenerates to exactly
+    :func:`cached_attention`'s mask.
+    """
+    t = k_cache.shape[1]
+    mask = jnp.arange(t)[None, None, :] <= positions[:, :, None]  # (B, W, T)
+    bias = jnp.where(mask[:, None], 0.0, _NEG_INF)  # (B, 1, W, T)
+    return dot_product_attention(
+        q, k_cache, v_cache, bias=bias, dtype=dtype, impl="dense"
     )
 
 

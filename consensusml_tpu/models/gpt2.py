@@ -18,9 +18,11 @@ import jax.numpy as jnp
 
 from consensusml_tpu.models.attention import (
     cached_attention,
+    cached_attention_window,
     dot_product_attention,
     gather_paged_kv,
     paged_update_kv_cache,
+    paged_update_kv_cache_window,
     update_kv_cache,
 )
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
@@ -92,14 +94,27 @@ class _DecoderBlock(nn.Module):
         qkv = nn.DenseGeneral((c.heads, 3 * d_head), dtype=c.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         if cache is not None and block_table is not None:
-            # paged decode step: the cache is a shared block pool; this
-            # slot's logical view assembles by block-table gather
-            # (serve/pool/ paged-KV path)
-            k_pages, v_pages, lengths = paged_update_kv_cache(
-                cache, k, v, block_table, positions
-            )
-            kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-            attn = cached_attention(q, kg, vg, lengths=lengths, dtype=c.dtype)
+            if positions.ndim == 2:
+                # paged VERIFY window (serve/pool/spec.py): W tokens per
+                # slot scattered + attended in one fixed-shape step
+                k_pages, v_pages = paged_update_kv_cache_window(
+                    cache, k, v, block_table, positions
+                )
+                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                attn = cached_attention_window(
+                    q, kg, vg, positions=positions, dtype=c.dtype
+                )
+            else:
+                # paged decode step: the cache is a shared block pool;
+                # this slot's logical view assembles by block-table
+                # gather (serve/pool/ paged-KV path)
+                k_pages, v_pages, lengths = paged_update_kv_cache(
+                    cache, k, v, block_table, positions
+                )
+                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                attn = cached_attention(
+                    q, kg, vg, lengths=lengths, dtype=c.dtype
+                )
             new_cache = {"k": k_pages, "v": v_pages}
         elif cache is not None:
             # decode step: write this token's K/V into the slot cache and
@@ -162,11 +177,22 @@ class GPT2LM(nn.Module):
         if block_table is not None and kv_cache is None:
             raise ValueError("block_table requires kv_cache (paged decode)")
         b, s = input_ids.shape
-        if kv_cache is not None and s != 1:
-            raise ValueError(f"decode steps are single-token, got seq len {s}")
+        multi = positions is not None and positions.ndim == 2
+        if kv_cache is not None and s != 1 and not multi:
+            raise ValueError(
+                f"decode steps are single-token, got seq len {s} (a "
+                "k-token verify window needs 2-D positions)"
+            )
+        if multi and (kv_cache is None or block_table is None):
+            raise ValueError(
+                "2-D positions (verify window) need kv_cache + block_table"
+            )
         tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="wte")
         x = tok_emb(input_ids)
-        pos = positions[:, None] if positions is not None else jnp.arange(s)[None, :]
+        if positions is None:
+            pos = jnp.arange(s)[None, :]
+        else:
+            pos = positions if multi else positions[:, None]
         x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="wpe")(pos)
         x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
         # static_argnums: `deterministic` is a python bool, not a tracer.

@@ -25,9 +25,11 @@ import jax.numpy as jnp
 from consensusml_tpu.models.attention import (
     apply_rope,
     cached_attention,
+    cached_attention_window,
     dot_product_attention,
     gather_paged_kv,
     paged_update_kv_cache,
+    paged_update_kv_cache_window,
     rope_frequencies,
     update_kv_cache,
 )
@@ -139,22 +141,44 @@ class _LlamaBlock(nn.Module):
         q = proj(c.heads * d, "q_proj")(y).reshape(b, s, c.heads, d)
         k = proj(c.kv_heads * d, "k_proj")(y).reshape(b, s, c.kv_heads, d)
         v = proj(c.kv_heads * d, "v_proj")(y).reshape(b, s, c.kv_heads, d)
-        pos2d = positions[:, None] if positions is not None else None
+        if positions is None:
+            pos2d = None
+        elif positions.ndim == 2:
+            pos2d = positions
+        else:
+            pos2d = positions[:, None]
         q = apply_rope(q, rope_table, pos2d)
         k = apply_rope(k, rope_table, pos2d)
         rep = c.heads // c.kv_heads
         if cache is not None and block_table is not None:
-            # paged decode: block-pool pages store pre-repeat (kv_heads)
-            # rows; GQA expansion happens on the gathered view
-            k_pages, v_pages, lengths = paged_update_kv_cache(
-                cache, k, v, block_table, positions
-            )
-            new_cache = {"k": k_pages, "v": v_pages}
-            kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-            if rep != 1:
-                kg = jnp.repeat(kg, rep, axis=2)
-                vg = jnp.repeat(vg, rep, axis=2)
-            attn = cached_attention(q, kg, vg, lengths=lengths, dtype=c.dtype)
+            if positions is not None and positions.ndim == 2:
+                # paged VERIFY window (serve/pool/spec.py): W tokens per
+                # slot; pages stay pre-repeat, GQA expands the gather
+                k_pages, v_pages = paged_update_kv_cache_window(
+                    cache, k, v, block_table, positions
+                )
+                new_cache = {"k": k_pages, "v": v_pages}
+                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                if rep != 1:
+                    kg = jnp.repeat(kg, rep, axis=2)
+                    vg = jnp.repeat(vg, rep, axis=2)
+                attn = cached_attention_window(
+                    q, kg, vg, positions=positions, dtype=c.dtype
+                )
+            else:
+                # paged decode: block-pool pages store pre-repeat
+                # (kv_heads) rows; GQA expansion happens on the gather
+                k_pages, v_pages, lengths = paged_update_kv_cache(
+                    cache, k, v, block_table, positions
+                )
+                new_cache = {"k": k_pages, "v": v_pages}
+                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                if rep != 1:
+                    kg = jnp.repeat(kg, rep, axis=2)
+                    vg = jnp.repeat(vg, rep, axis=2)
+                attn = cached_attention(
+                    q, kg, vg, lengths=lengths, dtype=c.dtype
+                )
         elif cache is not None:
             # decode: cache stores PRE-repeat (kv_heads) rows — GQA
             # expansion happens on the read, so the cache stays small
@@ -212,9 +236,16 @@ class LlamaLM(nn.Module):
             raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
         if block_table is not None and kv_cache is None:
             raise ValueError("block_table requires kv_cache (paged decode)")
-        if kv_cache is not None and input_ids.shape[1] != 1:
+        multi = positions is not None and positions.ndim == 2
+        if kv_cache is not None and input_ids.shape[1] != 1 and not multi:
             raise ValueError(
-                f"decode steps are single-token, got seq len {input_ids.shape[1]}"
+                f"decode steps are single-token, got seq len "
+                f"{input_ids.shape[1]} (a k-token verify window needs "
+                "2-D positions)"
+            )
+        if multi and (kv_cache is None or block_table is None):
+            raise ValueError(
+                "2-D positions (verify window) need kv_cache + block_table"
             )
         x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
         rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)

@@ -100,6 +100,7 @@ class RequestTrace:
         "trace_id", "request_id", "prompt_len", "t_start_us",
         "events", "decode_ticks", "defer_ticks", "preemptions",
         "generation", "finish_reason", "t_end_us",
+        "spec_proposed", "spec_accepted",
     )
 
     def __init__(self, ctx: TraceContext, prompt_len: int, ts_us: float):
@@ -112,6 +113,12 @@ class RequestTrace:
         self.defer_ticks = 0
         self.preemptions = 0
         self.generation = 0
+        # speculative-decode accounting (serve/pool/spec.py): per-stream
+        # draft tokens offered vs survivors — a stream with a bad
+        # acceptance rate shows up in the slowest-request table with its
+        # rejected drafts attached, not as unexplained decode ticks
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.finish_reason: str | None = None
         self.t_end_us: float | None = None
 
@@ -129,6 +136,8 @@ class RequestTrace:
             "defer_ticks": self.defer_ticks,
             "preemptions": self.preemptions,
             "generation": self.generation,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
             # rounding happens at export, never on the hot append path
             "events": [
                 dict(e, ts_us=round(e["ts_us"], 3)) for e in self.events
@@ -233,6 +242,19 @@ class RequestTraceRegistry:
                 tr.decode_ticks += 1
                 if tr.decode_ticks == 1:
                     tr.events.append({"name": "decode", "ts_us": ts})
+
+    def spec_ticks(self, rows) -> None:
+        """Speculative-round accounting, batch form like
+        :meth:`decode_ticks`: ``rows`` is an iterable of ``(request_id,
+        proposed, accepted)`` triples — ONE lock round-trip per verify
+        round covers every resident lane."""
+        with self._lock:
+            for rid, proposed, accepted in rows:
+                tr = self._active.get(rid) if rid else None
+                if tr is None:
+                    continue
+                tr.spec_proposed += int(proposed)
+                tr.spec_accepted += int(accepted)
 
     def finish(self, request_id: str | None, reason: str, **attrs) -> None:
         if not request_id:

@@ -20,10 +20,12 @@ callbacks and ZERO recompiles across steady-state steps.
 
 from consensusml_tpu.serve.export import (  # noqa: F401
     bump_generation,
+    export_draft,
     export_serving,
     load_serving,
     serving_meta,
 )
+from consensusml_tpu.serve.pool.spec import SpecConfig  # noqa: F401
 from consensusml_tpu.serve.decode import (  # noqa: F401
     DecodeModel,
     init_cache,

@@ -38,6 +38,16 @@ class GenResult:
     # their own observations to the server's trace on these
     trace_id: str = ""
     request_id: str = ""
+    # resolved sampling parameters, echoed for deterministic replay:
+    # re-submitting the same prompt with this exact (temperature, top_p,
+    # seed) triple reproduces the same token stream (docs/serving.md)
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    # speculative-decode accounting for THIS stream (0/0 on a non-spec
+    # engine): proposed = draft tokens offered, accepted = survivors
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 class RequestHandle:
@@ -90,6 +100,17 @@ class Request:
     # TraceContext (obs/requests.py); the engine mints one when the
     # client didn't send one, so ctx is always set post-submit
     ctx: Any = None
+    # per-request sampling (serve/sampling.py): temperature 0 = greedy,
+    # seed feeds the (seed, position) fold keys — same seed, same stream
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    # per-request stop token (submit() resolves the engine default in);
+    # None = stop on the token cap only
+    eos_id: int | None = None
+    # speculative accounting (engine-thread writes, _finish echoes)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclasses.dataclass

@@ -119,17 +119,23 @@ def _donate_cache() -> tuple[int, ...]:
 
 
 def make_prefill_fn(dm: DecodeModel) -> Callable:
-    """``prefill(params, cache, ids (1, L), length, slot)`` ->
-    ``(first_token, last_logits (V,), new_cache)``.
+    """``prefill(params, cache, ids (1, L), length, slot, temperature,
+    top_p, seed)`` -> ``(first_token, last_logits (V,), new_cache)``.
 
     One jit executable per padded bucket length ``L`` (compiled at
     warmup). Pad tokens DO run through the model — causal masking keeps
     every real position's logits exact, and the pad rows written into the
     cache sit beyond ``length`` where the decode mask never reads them.
+    The first generated token samples IN-JIT under the request's
+    ``(temperature, top_p, seed)`` at fold position ``length - 1``
+    (:mod:`consensusml_tpu.serve.sampling`); ``temperature = 0`` is the
+    original greedy argmax bit for bit.
     """
+    from consensusml_tpu.serve.sampling import sample_token
+
     model = dm.model
 
-    def prefill(params, cache, ids, length, slot):
+    def prefill(params, cache, ids, length, slot, temperature, top_p, seed):
         logits, kvs = model.apply(
             {"params": params}, ids, deterministic=True, return_kv=True
         )
@@ -150,18 +156,26 @@ def make_prefill_fn(dm: DecodeModel) -> Callable:
                     ),
                 }
             )
-        return jnp.argmax(last).astype(jnp.int32), last, new_cache
+        tok = sample_token(
+            last[None], temperature[None], top_p[None], seed[None],
+            (length - 1)[None],
+        )[0]
+        return tok, last, new_cache
 
     return jax.jit(prefill, donate_argnums=_donate_cache())
 
 
 def make_decode_fn(dm: DecodeModel) -> Callable:
-    """``decode(params, cache, tokens (S,), positions (S,))`` ->
-    ``(next_tokens (S,), new_cache)``. Greedy argmax inside the jit —
-    the host only ever fetches S int32s per step."""
+    """``decode(params, cache, tokens (S,), positions (S,), temperature
+    (S,), top_p (S,), seeds (S,))`` -> ``(next_tokens (S,), new_cache)``.
+    Sampling happens inside the jit under per-slot fold keys — the host
+    only ever fetches S int32s per step, and greedy lanes (temperature
+    0) are the argmax special case of the SAME executable."""
+    from consensusml_tpu.serve.sampling import sample_token
+
     model = dm.model
 
-    def decode(params, cache, tokens, positions):
+    def decode(params, cache, tokens, positions, temperature, top_p, seeds):
         logits, new_cache = model.apply(
             {"params": params},
             tokens[:, None],
@@ -169,7 +183,10 @@ def make_decode_fn(dm: DecodeModel) -> Callable:
             positions=positions,
             kv_cache=cache,
         )
-        return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), new_cache
+        toks = sample_token(
+            logits[:, 0], temperature, top_p, seeds, positions
+        )
+        return toks, new_cache
 
     return jax.jit(decode, donate_argnums=_donate_cache())
 

@@ -26,6 +26,18 @@ Paged mode adds three behaviors on top of the PR 5 loop
   flips params (and every resident slot's generation tag) between two
   decode steps with zero dropped streams and zero recompiles.
 
+Sampling is in-jit and per-request (:mod:`consensusml_tpu.serve.
+sampling`): ``submit(temperature=, top_p=, seed=, eos_id=)`` threads the
+triple through the compiled steps as data — greedy is the
+``temperature = 0`` case of the same executables, and a stream replays
+deterministically from its echoed seed. ``Engine(...,
+spec_decode=SpecConfig(model=draft, params=..., k=...))`` switches the
+per-token decode step for the speculative round
+(:mod:`consensusml_tpu.serve.pool.spec`): the draft proposes ``k``
+tokens per lane, ONE fused target forward verifies every lane's window,
+and rejection-sampling acceptance keeps the output distribution exactly
+target-only sampling (1 to ``k + 1`` tokens per lane per round).
+
 SLO instrumentation (docs/serving.md, docs/observability.md): every
 request path stage lands on the ``consensusml_serve_*`` /
 ``consensusml_pool_*`` metric families (TTFT, inter-token latency, queue
@@ -63,8 +75,11 @@ class ServeConfig:
     max_len: int = 0  # cache length; 0 = the model's max_len
     queue_depth: int = 64  # bounded admission queue
     max_new_tokens: int = 16  # default per-request generation cap
-    eos_id: int | None = None  # None: generation stops on the token cap
+    eos_id: int | None = None  # default stop token; submit() can override
     idle_wait_s: float = 0.02  # scheduler block when nothing is in flight
+    # -- default sampling (submit() overrides per request) ---------------
+    temperature: float = 0.0  # 0 = greedy argmax (the original path)
+    top_p: float = 1.0  # nucleus mass; 1.0 = full distribution
     # -- paged KV pool (serve/pool/; "slot" = the PR 5 per-slot rows) ----
     kv_impl: str = "paged"  # "paged" | "slot"
     block_size: int = 8  # tokens per physical KV block (must divide max_len)
@@ -81,7 +96,14 @@ class Engine:
     or call :meth:`shutdown` — it drains in-flight work by default.
     """
 
-    def __init__(self, model: Any, params: Any, config: ServeConfig | None = None):
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        config: ServeConfig | None = None,
+        *,
+        spec_decode: Any = None,
+    ):
         import jax
 
         from consensusml_tpu.obs import get_registry, get_tracer
@@ -141,6 +163,47 @@ class Engine:
             self._decode_fn = D.make_decode_fn(dm)
             self._sched = None
         self._score_fn = D.make_score_fn(dm)
+        # -- speculative decode (serve/pool/spec.py): a draft model over
+        # its own smaller pages, one fused k-verify on the target -------
+        self.spec = spec_decode
+        if self.spec is not None:
+            from consensusml_tpu.serve import pool as P
+
+            if not self.paged:
+                raise ValueError(
+                    "spec_decode requires kv_impl='paged' (the k-verify "
+                    "is a widening of the paged decode stage)"
+                )
+            sd = D.DecodeModel.wrap(self.spec.model)
+            if sd.vocab_size != dm.vocab_size:
+                raise ValueError(
+                    f"draft vocab {sd.vocab_size} != target vocab "
+                    f"{dm.vocab_size}; speculative acceptance compares "
+                    "distributions over one shared vocabulary"
+                )
+            if sd.max_len < self.max_len:
+                raise ValueError(
+                    f"draft max_len {sd.max_len} < engine max_len "
+                    f"{self.max_len}; the draft must reach every "
+                    "position the target serves"
+                )
+            self._draft_dm = sd
+            self._draft_params = jax.device_put(self.spec.params)
+            # the draft's pages share the pool's BLOCK TABLE (identical
+            # logical geometry: same blocks, same offsets) but are their
+            # own — smaller — arrays, sized by the draft architecture
+            self._draft_pages = P.init_pages(
+                sd, self._pool.num_blocks, cfg.block_size
+            )
+            self._draft_prefill_fn = P.make_paged_prefill_fn(sd)
+            self._propose_fn = P.make_draft_propose_fn(sd, self.spec.k)
+            self._verify_fn = P.make_verify_fn(dm, self.spec.k)
+            self._spec_extra_cols = (
+                P.spec_table_cols(
+                    self._pool.blocks_per_slot, cfg.block_size, self.spec.k
+                )
+                - self._pool.blocks_per_slot
+            )
         self._Request, self._RequestHandle = Request, RequestHandle
 
         self._queue: "queue.Queue" = queue.Queue(cfg.queue_depth)
@@ -214,6 +277,24 @@ class Engine:
             "consensusml_pool_evictions_total",
             "streams preempted by recompute on block-pool exhaustion",
         )
+        if self.spec is not None:
+            self._m_spec_rounds = reg.counter(
+                "consensusml_spec_rounds_total",
+                "speculative rounds (one draft scan + one fused verify)",
+            )
+            self._m_spec_proposed = reg.counter(
+                "consensusml_spec_proposed_total",
+                "draft tokens proposed across all live lanes",
+            )
+            self._m_spec_accepted = reg.counter(
+                "consensusml_spec_accepted_total",
+                "draft tokens accepted by the target's rejection sampler",
+            )
+            self._m_spec_rate = reg.gauge(
+                "consensusml_spec_acceptance_rate",
+                "accepted / proposed over the engine lifetime (sampled "
+                "per verify round) — the k-tuning signal",
+            )
         # live HBM tagging (obs/memviz.py): the engine's big resident
         # consumers as first-class gauges, so per-engine KV headroom is
         # a signal a fleet router can place traffic on (ROADMAP item 2)
@@ -270,6 +351,10 @@ class Engine:
         self._decode_time_s = 0.0
         self._evictions = 0
         self._swaps = 0
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_tokens = 0  # emitted by verify rounds (prefill excluded)
         self._error: BaseException | None = None
 
         self._thread = threading.Thread(
@@ -287,6 +372,10 @@ class Engine:
         block: bool = True,
         timeout: float | None = None,
         trace: Any = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+        eos_id: int | None = None,
     ):
         """Enqueue one request; returns a ``RequestHandle``.
 
@@ -296,6 +385,14 @@ class Engine:
         accepted request has a recorded trace (docs/observability.md
         "Request tracing").
 
+        ``temperature``/``top_p``/``seed`` sample THIS request
+        (defaults: the ``ServeConfig`` values / a freshly minted seed);
+        the resolved triple is echoed on the ``GenResult`` so the stream
+        replays deterministically — same seed, same tokens, whatever
+        else shares the batch. ``eos_id`` overrides the engine-wide stop
+        token per request (the two causal-LM families use different eos
+        ids; ``None`` keeps the config default).
+
         Raises ``queue.Full`` when the bounded queue is full (with
         ``block=False`` or after ``timeout``) and ``RuntimeError`` once
         the engine is draining — both count on
@@ -304,6 +401,23 @@ class Engine:
         max_new = (
             self.config.max_new_tokens if max_new_tokens is None else max_new_tokens
         )
+        temp = self.config.temperature if temperature is None else float(temperature)
+        tp = self.config.top_p if top_p is None else float(top_p)
+        if temp < 0:
+            raise ValueError(f"temperature must be >= 0, got {temp}")
+        if not 0 < tp <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {tp}")
+        if seed is None:
+            import os as _os
+
+            # greedy lanes never consume the seed; sampled lanes get a
+            # fresh one so independent requests draw independent streams
+            seed = (
+                0 if temp == 0
+                else int.from_bytes(_os.urandom(4), "little")
+            )
+        seed = int(seed) & 0xFFFFFFFF
+        eos = self.config.eos_id if eos_id is None else int(eos_id)
         if self._draining.is_set() or self._stop.is_set():
             self._m_rejected.inc()
             if self._error is not None:
@@ -326,7 +440,10 @@ class Engine:
 
         ctx = trace if trace is not None else TraceContext.mint("srv")
         handle = self._RequestHandle(len(ids))
-        req = self._Request(list(map(int, ids)), max_new, handle, ctx=ctx)
+        req = self._Request(
+            list(map(int, ids)), max_new, handle, ctx=ctx,
+            temperature=temp, top_p=tp, seed=seed, eos_id=eos,
+        )
         self._rt.start(
             ctx, len(ids), max_new_tokens=max_new, generation=self._generation
         )
@@ -374,45 +491,87 @@ class Engine:
 
         from consensusml_tpu.serve import decode as D
 
-        toks = jnp.zeros((self.config.num_slots,), jnp.int32)
+        s = self.config.num_slots
+        toks = jnp.zeros((s,), jnp.int32)
+        samp = (  # per-slot sampling arrays (all-greedy warms the same
+            jnp.zeros((s,), jnp.float32),  # executable sampled lanes use)
+            jnp.ones((s,), jnp.float32),
+            jnp.zeros((s,), jnp.uint32),
+        )
+        samp1 = (jnp.float32(0.0), jnp.float32(1.0), jnp.uint32(0))
         if self.paged:
             from consensusml_tpu.serve import pool as P
 
             bs = self.config.block_size
             pages = P.init_pages(self._dm, self._pool.num_blocks, bs)
+            dpages = (
+                P.init_pages(self._draft_dm, self._pool.num_blocks, bs)
+                if self.spec is not None
+                else None
+            )
             for b in buckets if buckets is not None else self.buckets:
                 ids = jnp.zeros((1, b), jnp.int32)
+                row = jnp.zeros((b // bs,), jnp.int32)
                 _tok, _logits, pages = self._prefill_fn(
-                    self._params, pages, ids, jnp.int32(1),
-                    jnp.zeros((b // bs,), jnp.int32),
+                    self._params, pages, ids, jnp.int32(1), row, *samp1
                 )
-            table = jnp.zeros(
-                (self.config.num_slots, self._pool.blocks_per_slot),
-                jnp.int32,
-            )
-            self._decode_fn(
-                self._params, pages, table, toks, jnp.zeros_like(toks)
-            )
+                if self.spec is not None:
+                    _t, _l, dpages = self._draft_prefill_fn(
+                        self._draft_params, dpages, ids, jnp.int32(1),
+                        row, *samp1,
+                    )
+            if self.spec is None:
+                # a speculative engine never runs the one-token decode
+                # step (_spec_step replaces it) — don't burn a compile
+                # on an executable that will not execute
+                table = jnp.zeros(
+                    (s, self._pool.blocks_per_slot), jnp.int32
+                )
+                _tok2, pages = self._decode_fn(
+                    self._params, pages, table, toks,
+                    jnp.zeros_like(toks), *samp,
+                )
+            else:
+                stable = jnp.zeros(
+                    (s, self._pool.blocks_per_slot + self._spec_extra_cols),
+                    jnp.int32,
+                )
+                props, q_sel, q_probs, dpages = self._propose_fn(
+                    self._draft_params, dpages, stable, toks,
+                    jnp.zeros_like(toks), *samp,
+                )
+                self._verify_fn(
+                    self._params, pages, stable, toks, props, q_sel,
+                    q_probs, jnp.zeros_like(toks), *samp,
+                )
             return self.compile_counts()
         cache = D.init_cache(self._dm, self.config.num_slots, self.max_len)
         for b in buckets if buckets is not None else self.buckets:
             ids = jnp.zeros((1, b), jnp.int32)
             _tok, _logits, cache = self._prefill_fn(
-                self._params, cache, ids, jnp.int32(1), jnp.int32(0)
+                self._params, cache, ids, jnp.int32(1), jnp.int32(0),
+                *samp1,
             )
-        self._decode_fn(self._params, cache, toks, jnp.zeros_like(toks))
+        self._decode_fn(
+            self._params, cache, toks, jnp.zeros_like(toks), *samp
+        )
         return self.compile_counts()
 
     def watch(self, path: str, poll_s: float = 0.25):
         """Arm the drain-free hot swap: poll ``path`` for a new artifact
         generation, stage it off-thread, flip between decode steps
-        (:mod:`consensusml_tpu.serve.pool.hotswap`). Returns the watcher."""
+        (:mod:`consensusml_tpu.serve.pool.hotswap`). On a speculative
+        engine the watcher also stages the DRAFT artifact riding in the
+        ``draft/`` subdirectory (``export_draft``) under the same
+        generation counter, so target and draft flip together. Returns
+        the watcher."""
         from consensusml_tpu.serve.pool import GenerationWatcher
 
         if self._watcher is not None:
             raise RuntimeError("engine is already watching an artifact dir")
         self._watcher = GenerationWatcher(
-            path, current_generation=self._generation, poll_s=poll_s
+            path, current_generation=self._generation, poll_s=poll_s,
+            stage_draft=self.spec is not None,
         )
         return self._watcher
 
@@ -436,17 +595,27 @@ class Engine:
             return
         import jax
 
-        old, new = jax.tree.leaves(self._params), jax.tree.leaves(sw.params)
-        ok = jax.tree.structure(self._params) == jax.tree.structure(
-            sw.params
-        ) and all(
-            a.shape == b.shape and a.dtype == b.dtype
-            for a, b in zip(old, new)
-        )
+        def _tree_matches(live, staged):
+            if jax.tree.structure(live) != jax.tree.structure(staged):
+                return False
+            return all(
+                a.shape == b.shape and a.dtype == b.dtype
+                for a, b in zip(jax.tree.leaves(live), jax.tree.leaves(staged))
+            )
+
+        ok = _tree_matches(self._params, sw.params)
+        if ok and self.spec is not None and sw.draft_params is not None:
+            # the draft flips with the target or not at all — a target
+            # from generation g+1 verifying a draft from g would still
+            # be distribution-correct, but the staged PAIR is what the
+            # export protocol promised, so a torn pair is rejected whole
+            ok = _tree_matches(self._draft_params, sw.draft_params)
         if not ok:
             self._watcher.reject(sw)  # roll back: a fixed same-gen
             return  # re-export must be stageable
         self._params = sw.params
+        if self.spec is not None and sw.draft_params is not None:
+            self._draft_params = sw.draft_params
         self._generation = sw.generation
         self._params_nbytes = sum(
             int(x.nbytes) for x in jax.tree.leaves(sw.params)
@@ -467,11 +636,18 @@ class Engine:
         """Jit-cache entry counts per program family — the
         zero-recompile-after-warmup assertion reads this."""
         out = {}
-        for name, fn in (
+        fams = [
             ("prefill", self._prefill_fn),
             ("decode", self._decode_fn),
             ("score", self._score_fn),
-        ):
+        ]
+        if self.spec is not None:
+            fams += [
+                ("draft_prefill", self._draft_prefill_fn),
+                ("propose", self._propose_fn),
+                ("verify", self._verify_fn),
+            ]
+        for name, fn in fams:
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if size is not None else -1
         return out
@@ -532,8 +708,46 @@ class Engine:
                     "block_size": bs,
                 },
             )
+            if self.spec is not None:
+                from consensusml_tpu.serve.pool.spec import (
+                    propose_cost_args,
+                    spec_table_cols,
+                    verify_cost_args,
+                )
+
+                k = self.spec.k
+                cols = spec_table_cols(self._pool.blocks_per_slot, bs, k)
+                dparams = st(self._draft_params)
+                dpages = st(self._draft_pages)
+                spec_meta = {**base_meta, "k": k}
+                for b in self.buckets:
+                    name = f"serve.draft_prefill.b{b}"
+                    rows[name] = ledger.register(
+                        name, self._draft_prefill_fn, dparams, dpages,
+                        *prefill_cost_args(b, bs),
+                        meta={**spec_meta, "bucket": b, "block_size": bs},
+                    )
+                rows["serve.spec.propose"] = ledger.register(
+                    "serve.spec.propose", self._propose_fn, dparams,
+                    dpages,
+                    *propose_cost_args(self.config.num_slots, cols),
+                    meta=spec_meta,
+                )
+                rows["serve.spec.verify"] = ledger.register(
+                    "serve.spec.verify", self._verify_fn, params, pages,
+                    *verify_cost_args(
+                        self.config.num_slots, cols, k,
+                        self._dm.vocab_size,
+                    ),
+                    meta=spec_meta,
+                )
         else:
             cache = st(self._cache)
+            samp1 = (
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.uint32),
+            )
             for b in self.buckets:
                 name = f"serve.prefill.b{b}"
                 rows[name] = ledger.register(
@@ -541,11 +755,15 @@ class Engine:
                     jax.ShapeDtypeStruct((1, b), jnp.int32),
                     jax.ShapeDtypeStruct((), jnp.int32),
                     jax.ShapeDtypeStruct((), jnp.int32),
+                    *samp1,
                     meta={**base_meta, "bucket": b},
                 )
             toks = jax.ShapeDtypeStruct((self.config.num_slots,), jnp.int32)
+            f32s = jax.ShapeDtypeStruct((self.config.num_slots,), jnp.float32)
+            u32s = jax.ShapeDtypeStruct((self.config.num_slots,), jnp.uint32)
             rows["serve.decode"] = ledger.register(
                 "serve.decode", self._decode_fn, params, cache, toks, toks,
+                f32s, f32s, u32s,
                 meta=base_meta,
             )
         # the hot-swap stage is a transfer, not a program: restore +
@@ -619,6 +837,26 @@ class Engine:
                     else 0.0
                 ),
             }
+        if self.spec is not None:
+            out["spec"] = {
+                "k": self.spec.k,
+                "rounds": self._spec_rounds,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": (
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed
+                    else 0.0
+                ),
+                # round-emitted tokens only (prefill firsts excluded),
+                # so intertoken_seconds / tokens_per_round is the honest
+                # per-token gap (docs/observability.md)
+                "tokens_per_round": (
+                    self._spec_tokens / self._spec_rounds
+                    if self._spec_rounds
+                    else 0.0
+                ),
+            }
         return out
 
     # -- engine thread ------------------------------------------------------
@@ -632,7 +870,10 @@ class Engine:
                     self._sched.start_tick()
                 self._admit_waiting()
                 if self._table.num_active:
-                    self._decode_step()
+                    if self.spec is not None:
+                        self._spec_step()
+                    else:
+                        self._decode_step()
                     continue
                 if self._draining.is_set() and q.empty() and not self._requeue:
                     break
@@ -773,6 +1014,11 @@ class Engine:
             continuation=bool(already),
         )
         t0 = time.perf_counter()
+        samp = (
+            jnp.float32(req.temperature),
+            jnp.float32(req.top_p),
+            jnp.uint32(req.seed),
+        )
         with self._tracer.span("serve.prefill", bucket=bucket, slot=idx):
             if self.paged:
                 from consensusml_tpu.serve.pool import blocks_for_tokens
@@ -781,13 +1027,28 @@ class Engine:
                 # cover the prompt AND the first decode write (position n)
                 self._pool.alloc(idx, blocks_for_tokens(n + 1, bs))
                 try:
+                    row = jnp.asarray(self._pool.block_row(idx, bucket // bs))
                     tok_dev, _logits, self._pages = self._prefill_fn(
                         self._params,
                         self._pages,
                         jnp.asarray(ids),
                         jnp.int32(n),
-                        jnp.asarray(self._pool.block_row(idx, bucket // bs)),
+                        row,
+                        *samp,
                     )
+                    if self.spec is not None:
+                        # the draft's pages need the prompt too: same
+                        # block row, the draft's own page arrays (its
+                        # sampled token is discarded — the target's is
+                        # the stream's first token)
+                        _dt, _dl, self._draft_pages = self._draft_prefill_fn(
+                            self._draft_params,
+                            self._draft_pages,
+                            jnp.asarray(ids),
+                            jnp.int32(n),
+                            row,
+                            *samp,
+                        )
                 except BaseException:
                     self._pool.release(idx)  # no leaked blocks on a raise
                     raise
@@ -798,6 +1059,7 @@ class Engine:
                     jnp.asarray(ids),
                     jnp.int32(n),
                     jnp.int32(idx),
+                    *samp,
                 )
             tok = int(tok_dev)  # device fence: the first token is real now
         now = time.perf_counter()
@@ -816,8 +1078,8 @@ class Engine:
         req.handle._emit(tok)
         self._m_tokens.inc()
         self._tokens_out += 1
-        if already + 1 >= req.max_new_tokens or tok == self.config.eos_id:
-            reason = "eos" if tok == self.config.eos_id else "max_tokens"
+        if already + 1 >= req.max_new_tokens or tok == req.eos_id:
+            reason = "eos" if tok == req.eos_id else "max_tokens"
             if self.paged:
                 self._pool.release(idx)
             self._finish_handle(req, req.handle._all, reason, ttft=ttft)
@@ -862,24 +1124,30 @@ class Engine:
         self._evictions += 1
         self._m_evictions.inc()
 
-    def _grow_blocks(self) -> None:
-        """Before a paged step: give every lane whose next write crosses
-        into a new block that block, evicting youngest-first when the
+    def _grow_blocks(self, extra_tokens: int = 0) -> None:
+        """Before a paged step: give every lane the blocks its writes
+        need — the next position, plus ``extra_tokens`` more for a
+        speculative verify window — evicting youngest-first when the
         pool is exhausted (the lane needing the block may itself be the
-        youngest — then it preempts itself and re-enters via requeue)."""
+        youngest — then it preempts itself and re-enters via requeue).
+        Window positions past ``blocks_per_slot`` are NOT allocated:
+        they overflow into the trash-padded table columns by design."""
         bs = self.config.block_size
+        bps = self._pool.blocks_per_slot
         for i, _slot in self._table.active:
             while True:
                 slot = self._table.slots[i]
                 if slot is None:
                     break  # evicted while resolving an earlier lane
-                if slot.next_pos // bs < len(self._pool.owned(i)):
-                    break  # this step's write block is already owned
+                target = min(
+                    bps, (slot.next_pos + extra_tokens) // bs + 1
+                )
+                if len(self._pool.owned(i)) >= target:
+                    break  # this step's write blocks are already owned
                 from consensusml_tpu.serve.pool import NoFreeBlocks
 
                 try:
                     self._pool.extend(i, 1)
-                    break
                 except NoFreeBlocks:
                     victim = self._youngest_active()
                     self._evict(victim)
@@ -895,11 +1163,7 @@ class Engine:
                 return
         active = self._table.active
         s = self.config.num_slots
-        tokens = np.zeros((s,), np.int32)
-        positions = np.zeros((s,), np.int32)
-        for i, slot in active:
-            tokens[i] = slot.pending
-            positions[i] = slot.next_pos
+        tokens, positions, temps, tops, seeds = self._slot_arrays(active)
         t0 = time.perf_counter()
         with self._tracer.span("serve.decode_step", active=len(active)):
             if self.paged:
@@ -909,10 +1173,15 @@ class Engine:
                     self._pool.device_table(),
                     jnp.asarray(tokens),
                     jnp.asarray(positions),
+                    jnp.asarray(temps),
+                    jnp.asarray(tops),
+                    jnp.asarray(seeds),
                 )
             else:
                 next_dev, self._cache = self._decode_fn(
-                    self._params, self._cache, jnp.asarray(tokens), jnp.asarray(positions)
+                    self._params, self._cache, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(temps),
+                    jnp.asarray(tops), jnp.asarray(seeds),
                 )
             next_toks = np.asarray(next_dev)  # device fence per step
         dt = time.perf_counter() - t0
@@ -945,8 +1214,35 @@ class Engine:
             [self._rid(slot.request) for _i, slot in active]
         )
         for i, slot in active:
-            tok = int(next_toks[i])
-            slot.request.handle._emit(tok)
+            self._emit_and_advance(i, slot, [int(next_toks[i])], now)
+
+    def _slot_arrays(self, active):
+        """Fixed-shape per-slot host arrays for one device step: pending
+        tokens, write positions, and each lane's sampling triple (free
+        lanes stay zero — greedy over garbage into the trash block)."""
+        s = self.config.num_slots
+        tokens = np.zeros((s,), np.int32)
+        positions = np.zeros((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        tops = np.ones((s,), np.float32)
+        seeds = np.zeros((s,), np.uint32)
+        for i, slot in active:
+            tokens[i] = slot.pending
+            positions[i] = slot.next_pos
+            temps[i] = slot.request.temperature
+            tops[i] = slot.request.top_p
+            seeds[i] = slot.request.seed
+        return tokens, positions, temps, tops, seeds
+
+    def _emit_and_advance(self, i, slot, toks, now) -> int:
+        """Emit ``toks`` (one decode token, or a verify round's accepted
+        prefix + final) on lane ``i``, advancing position/generation
+        bookkeeping one token at a time so eos / token-cap / length
+        stops land at the exact right token — tokens past the stop are
+        dropped, not emitted. Returns the number actually emitted."""
+        req = slot.request
+        for emitted, tok in enumerate(toks, start=1):
+            req.handle._emit(tok)
             self._m_tokens.inc()
             self._tokens_out += 1
             slot.generated += 1
@@ -954,9 +1250,9 @@ class Engine:
             slot.pending = tok
             slot.last_token_t = now
             reason = None
-            if tok == self.config.eos_id:
+            if tok == req.eos_id:
                 reason = "eos"
-            elif slot.generated >= slot.request.max_new_tokens:
+            elif slot.generated >= req.max_new_tokens:
                 reason = "max_tokens"
             elif slot.next_pos >= self.max_len:
                 reason = "length"  # safety net; submit() validation bounds it
@@ -965,9 +1261,120 @@ class Engine:
                 if self.paged:
                     self._pool.release(i)
                 self._finish_handle(
-                    slot.request, slot.request.handle._all, reason,
+                    req, req.handle._all, reason,
                     ttft=slot.ttft_s, generation=slot.generation,
                 )
+                return emitted
+        return len(toks)
+
+    def _spec_step(self) -> None:
+        """One speculative round: draft proposes ``k`` tokens per lane
+        (one scan executable), the target verifies ALL lanes' windows in
+        ONE fused forward, and each lane commits its accepted prefix +
+        the replacement/bonus token — 1 to ``k + 1`` tokens per lane per
+        round, two device dispatches, one host fence."""
+        k = self.spec.k
+        self._grow_blocks(extra_tokens=k)
+        if not self._table.num_active:  # everything preempted
+            return
+        import jax.numpy as jnp
+
+        active = self._table.active
+        tokens, positions, temps, tops, seeds = self._slot_arrays(active)
+        table = self._pool.device_table(self._spec_extra_cols)
+        t0 = time.perf_counter()
+        with self._tracer.span("serve.spec_step", active=len(active), k=k):
+            props_dev, q_sel, q_probs, self._draft_pages = self._propose_fn(
+                self._draft_params,
+                self._draft_pages,
+                table,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(temps),
+                jnp.asarray(tops),
+                jnp.asarray(seeds),
+            )
+            n_acc_dev, final_dev, self._pages = self._verify_fn(
+                self._params,
+                self._pages,
+                table,
+                jnp.asarray(tokens),
+                props_dev,
+                q_sel,
+                q_probs,
+                jnp.asarray(positions),
+                jnp.asarray(temps),
+                jnp.asarray(tops),
+                jnp.asarray(seeds),
+            )
+            props = np.asarray(props_dev)  # device fence per round
+            n_acc = np.asarray(n_acc_dev)
+            finals = np.asarray(final_dev)
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        self._m_intertoken.observe(
+            dt,
+            exemplar=self._rid(
+                min(active, key=lambda t: t[1].request.arrival_t)[1].request
+            ),
+        )
+        self._step_times.append(dt)
+        self._decode_time_s += dt
+        self._decode_steps += 1
+        s = self.config.num_slots
+        self._occupancy_sum += len(active) / s
+        self._m_occupancy.set(len(active) / s)
+        self._rt.decode_ticks(
+            [self._rid(slot.request) for _i, slot in active]
+        )
+        round_emitted = 0
+        round_accepted = 0
+        # per-stream accounting lands BEFORE emission: a request this
+        # round finishes must carry its final round on its trace too
+        spec_rows = []
+        for i, slot in active:
+            n = int(n_acc[i])
+            req = slot.request
+            req.spec_proposed += k
+            req.spec_accepted += n
+            round_accepted += n
+            spec_rows.append((self._rid(req), k, n))
+        self._rt.spec_ticks(spec_rows)
+        for i, slot in active:
+            n = int(n_acc[i])
+            toks = [int(props[i, j]) for j in range(n)] + [int(finals[i])]
+            round_emitted += self._emit_and_advance(i, slot, toks, now)
+        if self._pool.free_blocks == 0:
+            # rejected-suffix rollback, lazily: positions rolled back
+            # above (next_pos only advanced past the accepted prefix);
+            # the over-allocated window-tail blocks are handed back only
+            # under pool pressure — otherwise the very next round would
+            # re-extend the same lanes and churn the device block table
+            # every round for nothing
+            bs = self.config.block_size
+            for i, slot in self._table.active:
+                self._pool.shrink(i, slot.next_pos // bs + 1)
+        self._spec_rounds += 1
+        self._spec_proposed += k * len(active)
+        self._spec_accepted += round_accepted
+        self._spec_tokens += round_emitted
+        self._m_spec_rounds.inc()
+        self._m_spec_proposed.inc(k * len(active))
+        self._m_spec_accepted.inc(round_accepted)
+        self._m_spec_rate.set(
+            self._spec_accepted / self._spec_proposed
+            if self._spec_proposed
+            else 0.0
+        )
+        if dt > 0:
+            self._m_tps.set(round_emitted / dt)
+        occ = self._pool.used_blocks / self._pool.usable_blocks
+        self._block_occupancy_sum += occ
+        self._m_block_occ.set(occ)
+        self._m_blocks_free.set(self._pool.free_blocks)
+        self._m_pool_hbm_free.set(
+            self._pool.free_blocks * self._block_nbytes
+        )
 
     def _finish_handle(
         self, req, tokens, reason: str, ttft: float = 0.0,
@@ -990,6 +1397,11 @@ class Engine:
                 ),
                 trace_id=getattr(ctx, "trace_id", ""),
                 request_id=getattr(ctx, "request_id", ""),
+                temperature=req.temperature,
+                top_p=req.top_p,
+                seed=req.seed,
+                spec_proposed=req.spec_proposed,
+                spec_accepted=req.spec_accepted,
             )
         )
         self._rt.finish(
@@ -1001,17 +1413,36 @@ class Engine:
             self._m_completed.inc()
 
 
-def load_engine(path: str, config: ServeConfig | None = None) -> Engine:
+def load_engine(
+    path: str, config: ServeConfig | None = None, *, spec_k: int = 0
+) -> Engine:
     """Build an :class:`Engine` from a serving artifact directory: the
     meta names the config, :func:`configs.build` rebuilds the
     architecture, and the consensus-mean params load in. Raises on
-    non-LM artifacts (only causal LMs have a decode path)."""
+    non-LM artifacts (only causal LMs have a decode path).
+
+    ``spec_k > 0`` additionally loads the DRAFT artifact from the
+    ``draft/`` subdirectory (:func:`consensusml_tpu.serve.export.
+    export_draft`) and serves speculatively with that proposal depth;
+    raises when no draft artifact rides the directory."""
+    import os
+
     from consensusml_tpu import configs
-    from consensusml_tpu.serve.export import load_serving
+    from consensusml_tpu.serve.export import DRAFT_SUBDIR, load_serving
 
     meta, params, _model_state = load_serving(path)
     bundle = configs.build(meta["config_name"], meta.get("scale", "smoke"))
-    engine = Engine(bundle.model, params, config)
+    spec = None
+    if spec_k:
+        from consensusml_tpu.serve.pool import SpecConfig
+
+        draft_dir = os.path.join(path, DRAFT_SUBDIR)
+        dmeta, dparams, _dms = load_serving(draft_dir)  # raises w/ context
+        dbundle = configs.build(
+            dmeta["config_name"], dmeta.get("scale", "smoke")
+        )
+        spec = SpecConfig(model=dbundle.model, params=dparams, k=spec_k)
+    engine = Engine(bundle.model, params, config, spec_decode=spec)
     # seed the hot-swap ordering key from the artifact: watch() must
     # reject re-reads of THIS generation, not just generation 0
     engine._generation = int(meta.get("generation", 0))

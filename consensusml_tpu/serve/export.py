@@ -42,14 +42,20 @@ from consensusml_tpu.utils.tree import consensus_mean
 
 __all__ = [
     "export_serving",
+    "export_draft",
     "load_serving",
     "serving_meta",
     "bump_generation",
     "META_NAME",
+    "DRAFT_SUBDIR",
 ]
 
 META_NAME = "serve_meta.json"
 _MODEL_SUBDIR = "model"
+# the speculative DRAFT artifact rides inside the target artifact dir;
+# the PARENT meta's generation orders both (the watcher restages the
+# pair whenever the parent generation advances — serve/pool/hotswap.py)
+DRAFT_SUBDIR = "draft"
 
 
 def _host_value(v: Any):
@@ -145,6 +151,53 @@ def export_serving(
         }
         _write_meta(path, meta)
     return path
+
+
+def export_draft(
+    path: str,
+    params: Any,
+    *,
+    config_name: str,
+    scale: str = "smoke",
+) -> str:
+    """Install a speculative DRAFT artifact alongside the target at
+    ``path`` (``<path>/draft/`` — itself a complete serving artifact, so
+    :func:`load_serving` reads it directly).
+
+    The draft rides the PARENT's generation protocol: it carries no
+    ordering of its own (its meta mirrors the parent generation at write
+    time, provenance only), and a hot-swapping engine restages
+    target + draft as a pair whenever the parent's generation advances —
+    one counter, one flip, never a half-swapped pair. Written under the
+    parent's generation lock so a concurrent target export cannot
+    observe a torn draft directory.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    draft_dir = os.path.join(path, DRAFT_SUBDIR)
+    host = jax.tree.map(_host_value, params)
+    with _generation_lock(path):
+        try:
+            parent_gen = int(serving_meta(path).get("generation", 0))
+        except ValueError:
+            parent_gen = 0  # draft installed before the first export
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(
+                os.path.join(draft_dir, _MODEL_SUBDIR),
+                {"params": host, "model_state": {}},
+                force=True,
+            )
+        _write_meta(
+            draft_dir,
+            {
+                "config_name": config_name,
+                "scale": scale,
+                "role": "draft",
+                "generation": parent_gen,
+            },
+        )
+    return draft_dir
 
 
 def _write_meta(path: str, meta: dict[str, Any]) -> None:

@@ -35,6 +35,12 @@ from consensusml_tpu.serve.pool.hotswap import (  # noqa: F401
     GenerationWatcher,
     StagedSwap,
 )
+from consensusml_tpu.serve.pool.spec import (  # noqa: F401
+    SpecConfig,
+    make_draft_propose_fn,
+    make_verify_fn,
+    spec_table_cols,
+)
 
 __all__ = [
     "BlockPool",
@@ -47,4 +53,8 @@ __all__ = [
     "make_paged_prefill_fn",
     "GenerationWatcher",
     "StagedSwap",
+    "SpecConfig",
+    "make_draft_propose_fn",
+    "make_verify_fn",
+    "spec_table_cols",
 ]

@@ -183,6 +183,32 @@ class BlockPool:
         self._dev_table = None
         return got
 
+    def shrink(self, slot: int, keep_blocks: int) -> list[int]:
+        """Return ``slot``'s blocks BEYOND the first ``keep_blocks`` to
+        the free list (speculative rollback: a rejected draft suffix
+        hands its over-allocated tail back; the kept prefix — committed
+        tokens plus the next write — is untouched). Freed table entries
+        reset to trash. Returns the freed ids (possibly empty)."""
+        owned = self._owned.get(slot)
+        if owned is None:
+            raise RuntimeError(f"slot {slot} owns nothing; alloc first")
+        if keep_blocks < 1:
+            raise ValueError(
+                f"keep_blocks must be >= 1, got {keep_blocks} (release() "
+                "frees a slot outright)"
+            )
+        freed = []
+        while len(owned) > keep_blocks:
+            b = owned.pop()
+            if b == TRASH_BLOCK or b in self._free:
+                raise RuntimeError(f"corrupt free list: block {b}")
+            self._free.append(b)
+            self._table[slot, len(owned)] = TRASH_BLOCK
+            freed.append(b)
+        if freed:
+            self._dev_table = None
+        return freed
+
     def release(self, slot: int) -> list[int]:
         """Return all of ``slot``'s blocks to the free list and reset its
         table row to the trash block."""
@@ -209,14 +235,29 @@ class BlockPool:
         row[:n] = owned[:n]
         return row
 
-    def device_table(self):
+    def device_table(self, extra_cols: int = 0):
         """The block table as a device array (cached; host→device copy
-        only after a mutation, never inside the decode step)."""
-        if self._dev_table is None:
+        only after a mutation, never inside the decode step).
+
+        ``extra_cols > 0`` appends that many TRASH columns — the
+        speculative verify window's overflow guard: a stream within
+        ``k`` tokens of ``max_len`` computes window positions past its
+        real row, and ``table[s, pos // bs]`` must resolve those to the
+        trash block rather than index-clamp into the slot's LAST owned
+        block (which holds live tokens). Cached per width."""
+        if self._dev_table is None:  # invalidated by a mutation
+            self._dev_table = {}
+        if extra_cols not in self._dev_table:
             import jax.numpy as jnp
 
-            self._dev_table = jnp.asarray(self._table)
-        return self._dev_table
+            table = self._table
+            if extra_cols:
+                pad = np.full(
+                    (self.num_slots, extra_cols), TRASH_BLOCK, np.int32
+                )
+                table = np.concatenate([table, pad], axis=1)
+            self._dev_table[extra_cols] = jnp.asarray(table)
+        return self._dev_table[extra_cols]
 
     def check(self) -> None:
         """Invariant sweep (tests + debug): free ∪ owned partitions the

@@ -46,6 +46,10 @@ class StagedSwap:
     # to tell "same bad artifact still on disk" from "corrected artifact
     # rewritten at the same generation"
     meta_mtime: float = 0.0
+    # speculative DRAFT params staged alongside the target (watcher
+    # built with stage_draft=True and a draft/ subartifact present);
+    # None on non-speculative engines — the engine flips target-only
+    draft_params: Any = None
 
 
 class GenerationWatcher:
@@ -65,11 +69,15 @@ class GenerationWatcher:
         current_generation: int = 0,
         poll_s: float = 0.25,
         loader: Callable[[str], tuple[dict, Any, Any]] | None = None,
+        stage_draft: bool = False,
     ):
         from consensusml_tpu.obs import get_registry
 
         self.path = path
         self.poll_s = poll_s
+        # speculative engines: restage the draft/ subartifact with every
+        # parent-generation advance (the parent counter orders the pair)
+        self.stage_draft = stage_draft
         self.generation = current_generation  # newest ACCEPTED generation
         self._loader = loader
         self._staged: StagedSwap | None = None
@@ -145,13 +153,25 @@ class GenerationWatcher:
             from consensusml_tpu.serve.export import load_serving
 
             _meta, params, _ms = load_serving(self.path)
+        draft_params = None
+        if self.stage_draft:
+            from consensusml_tpu.serve.export import DRAFT_SUBDIR, load_serving
+
+            draft_dir = os.path.join(self.path, DRAFT_SUBDIR)
+            if os.path.isdir(draft_dir):
+                # a torn draft read raises -> _run retries next poll;
+                # the pair stages together or not at all
+                _dmeta, draft_params, _dms = load_serving(draft_dir)
+                draft_params = jax.device_put(draft_params)
         params = jax.device_put(params)
         # force the H2D copies HERE, not lazily at the engine's first
         # post-flip step (that would be a hidden prefill-sized stall)
         jax.block_until_ready(params)
+        if draft_params is not None:
+            jax.block_until_ready(draft_params)
         self._m_load.observe(time.perf_counter() - t0)
         with self._lock:
-            self._staged = StagedSwap(gen, params, meta, mtime)
+            self._staged = StagedSwap(gen, params, meta, mtime, draft_params)
             self.generation = gen
         self._m_staged.inc()
         return True

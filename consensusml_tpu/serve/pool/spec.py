@@ -1,0 +1,294 @@
+"""Speculative decode: draft-propose-k, ONE fused k-token verify.
+
+The paged decode stage emits one token per target forward; raw tokens/s
+is bounded by the target model's step latency however many lanes ride
+the batch. Speculative decoding (Leviathan et al. / Chen et al.) breaks
+that bound with two fixed-shape executables per engine tick:
+
+- **propose** — a small DRAFT model (same forward hooks as the target,
+  its own smaller KV pages over the SAME block table) runs ``k``
+  single-token decode steps as one ``lax.scan`` jit, sampling each
+  proposal under the canonical ``(seed, position)`` fold keys
+  (:mod:`consensusml_tpu.serve.sampling`) and returning the proposals
+  plus the draft's full sampling distributions;
+- **verify** — ONE target forward over the ``k + 1``-token window
+  ``[pending, x_1 .. x_k]`` per slot (the fixed-shape widening of the
+  decode stage: same paged gather, same length-mask argument), followed
+  IN-JIT by rejection-sampling acceptance:
+
+  accept ``x_i`` iff ``u_i * q_i(x_i) <= p_i(x_i)`` (``u_i`` uniform
+  under the ``ACCEPT_TAG`` key at position ``p0 + i - 1``); on the first
+  rejection, emit a replacement drawn from the residual
+  ``max(p_i - q_i, 0)`` renormalized (``RESIDUAL_TAG``); if all ``k``
+  survive, emit the bonus token from ``p_{k+1}`` under the SAME
+  ``SAMPLE_TAG`` key plain decode would have used at that position.
+
+  The emitted stream is therefore distributed EXACTLY as target-only
+  sampling — and when the draft IS the target (the test fixture), every
+  acceptance ratio is 1 and the stream is bit-for-bit the target-only
+  stream, because every draw reuses the plain path's key schedule.
+
+KV bookkeeping: verify scatters all ``k + 1`` rows; the accepted prefix
+is committed by advancing ``next_pos`` on the host (ints only, no
+device sync), and the rejected suffix needs no device rollback — its
+rows sit past the committed length, masked to exactly zero probability,
+and the next window overwrites them. The draft cache self-heals the
+same way. Overflow positions near ``max_len`` route through the
+engine's trash-padded block-table columns
+(:meth:`~consensusml_tpu.serve.pool.blocks.BlockPool.device_table`).
+
+Both executables are step-over-step jaxpr-contract-pinned
+(``analysis/jaxpr_contracts.py``: no host callbacks, no f64, canonical
+hash stable across sampled ticks) and registered in the cost ledger
+(``Engine.register_costs``: ``serve.spec.propose`` /
+``serve.spec.verify`` rows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+__all__ = [
+    "SpecConfig",
+    "make_draft_propose_fn",
+    "make_verify_fn",
+    "propose_cost_args",
+    "verify_cost_args",
+    "spec_table_cols",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decode configuration for :class:`~consensusml_tpu.
+    serve.engine.Engine`.
+
+    ``model``/``params`` are the draft causal LM (GPT2LM/LlamaLM — any
+    model the serving forward contract covers; it must share the
+    target's vocab). ``k`` is the proposal depth: each engine tick costs
+    one draft scan of ``k`` steps plus ONE target verify, and emits
+    between 1 and ``k + 1`` tokens per live lane. Higher ``k`` amortizes
+    the target forward further but wastes more draft work per
+    rejection — tune against the measured acceptance rate
+    (``consensusml_spec_acceptance_rate``; docs/serving.md "Choosing
+    k")."""
+
+    model: Any
+    params: Any
+    k: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {self.k}")
+
+
+def spec_table_cols(blocks_per_slot: int, block_size: int, k: int) -> int:
+    """Block-table width the speculative stages index: the real columns
+    plus enough TRASH padding that the verify window's worst-case
+    position (``max_len - 1 + k``) still resolves in-bounds."""
+    return blocks_per_slot + (block_size - 1 + k) // block_size
+
+
+def propose_cost_args(num_slots: int, table_cols: int) -> tuple:
+    """Abstract ``(block_table, tokens, positions, temperature, top_p,
+    seeds)`` shape structs of the draft-propose executable for the cost
+    ledger's AOT lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((num_slots, table_cols), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.uint32),
+    )
+
+
+def verify_cost_args(
+    num_slots: int, table_cols: int, k: int, vocab: int
+) -> tuple:
+    """Abstract ``(block_table, tokens, proposals, q_sel, q_probs,
+    positions, temperature, top_p, seeds)`` shape structs of the ONE
+    k-verify executable."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((num_slots, table_cols), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots, k), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots, k, vocab), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.uint32),
+    )
+
+
+def make_draft_propose_fn(draft_dm: Any, k: int) -> Callable:
+    """``propose(draft_params, draft_pages, block_table, tokens (S,),
+    positions (S,), temperature (S,), top_p (S,), seeds (S,))`` ->
+    ``(proposals (S, k), q_sel (S, k), q_probs (S, k, V),
+    new_draft_pages)``.
+
+    ``lax.scan`` of ``k + 1`` draft decode steps in ONE executable: step
+    ``i`` writes the current token's draft K/V at position ``p0 + i``
+    (same paged scatter as the target decode stage, against the draft's
+    own pages) and samples proposal ``x_{i+1}`` under the plain path's
+    ``SAMPLE_TAG`` key at that position — so a draft that equals the
+    target proposes exactly the tokens target-only decode would emit.
+    The ``k + 1``-th step exists for its WRITE, not its sample (which is
+    discarded): it commits ``x_k``'s draft K/V at ``p0 + k``, so after a
+    fully-accepted round the draft cache has no gap at the next round's
+    prefix (every draft row is written exactly once, by the same
+    decode-step math the plain path would use — which is what keeps the
+    self-draft fixture bit-exact). ``q_sel`` is the draft probability of
+    each chosen token (the acceptance ratio's denominator), ``q_probs``
+    the full distributions (the residual re-draw's subtrahend).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve.decode import _donate_cache
+    from consensusml_tpu.serve.sampling import (
+        SAMPLE_TAG,
+        adjusted_probs,
+        categorical_from_probs,
+        sampling_keys,
+    )
+
+    model = draft_dm.model
+
+    def propose(
+        draft_params, draft_pages, block_table, tokens, positions,
+        temperature, top_p, seeds,
+    ):
+        def body(carry, _):
+            tok, pos, pages = carry
+            logits, pages = model.apply(
+                {"params": draft_params},
+                tok[:, None],
+                deterministic=True,
+                positions=pos,
+                kv_cache=pages,
+                block_table=block_table,
+            )
+            probs = adjusted_probs(logits[:, 0], temperature, top_p)
+            nxt = categorical_from_probs(
+                sampling_keys(seeds, pos, SAMPLE_TAG), probs
+            )
+            q = jnp.take_along_axis(probs, nxt[:, None], axis=1)[:, 0]
+            return (nxt, pos + 1, pages), (nxt, q, probs)
+
+        (_, _, new_pages), (props, q_sel, q_probs) = jax.lax.scan(
+            body, (tokens, positions, draft_pages), None, length=k + 1
+        )
+        # scan stacks along axis 0; slots lead outside. The final step's
+        # sample is the write-only tail — dropped here.
+        return (
+            jnp.moveaxis(props[:k], 0, 1),
+            jnp.moveaxis(q_sel[:k], 0, 1),
+            jnp.moveaxis(q_probs[:k], 0, 1),
+            new_pages,
+        )
+
+    return jax.jit(propose, donate_argnums=_donate_cache())
+
+
+def make_verify_fn(dm: Any, k: int) -> Callable:
+    """``verify(params, pages, block_table, tokens (S,), proposals
+    (S, k), q_sel (S, k), q_probs (S, k, V), positions (S,), temperature
+    (S,), top_p (S,), seeds (S,))`` -> ``(n_accept (S,), final (S,),
+    new_pages)``.
+
+    The one fused verify: a ``k + 1``-token target forward per slot
+    (window ``[pending, x_1 .. x_k]`` at positions ``p0 .. p0 + k``),
+    then branchless rejection-sampling acceptance entirely in-jit. The
+    emitted tokens for a lane are ``x_1 .. x_{n_accept}`` followed by
+    ``final`` (the residual replacement at the first rejected row, or
+    the bonus draw when everything survived); the host reads back three
+    small arrays and does pure int bookkeeping.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve.decode import _donate_cache
+    from consensusml_tpu.serve.sampling import (
+        ACCEPT_TAG,
+        RESIDUAL_TAG,
+        SAMPLE_TAG,
+        adjusted_probs,
+        categorical_from_probs,
+        sampling_keys,
+    )
+
+    model = dm.model
+
+    def verify(
+        params, pages, block_table, tokens, proposals, q_sel, q_probs,
+        positions, temperature, top_p, seeds,
+    ):
+        window = jnp.concatenate([tokens[:, None], proposals], axis=1)
+        pos_mat = positions[:, None] + jnp.arange(k + 1)[None, :]
+        logits, new_pages = model.apply(
+            {"params": params},
+            window,
+            deterministic=True,
+            positions=pos_mat,
+            kv_cache=pages,
+            block_table=block_table,
+        )
+        # target distributions for every window row, same temp/top-p
+        # transform as the draft applied (the acceptance ratio compares
+        # like with like — docs/serving.md "Acceptance math")
+        p_dist = adjusted_probs(
+            logits, temperature[:, None], top_p[:, None]
+        )  # (S, k+1, V)
+        p_sel = jnp.take_along_axis(
+            p_dist[:, :k], proposals[:, :, None], axis=2
+        )[:, :, 0]  # (S, k)
+        u = jax.vmap(jax.random.uniform)(
+            sampling_keys(
+                seeds[:, None] + jnp.zeros((1, k), jnp.uint32),
+                pos_mat[:, :k],
+                ACCEPT_TAG,
+            ).reshape(-1, 2)
+        ).reshape(p_sel.shape)
+        # accept x_i with prob min(1, p_i/q_i); u*q <= p avoids the
+        # division (q == p — the self-draft fixture — accepts at u < 1,
+        # i.e. always, which is what makes that stream bit-exact)
+        accept = u * q_sel <= p_sel
+        prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        n_accept = jnp.sum(prefix, axis=1)  # (S,) in [0, k]
+        # one fallback draw per row: rows < k resample the residual
+        # max(p - q, 0) (renormalized; an all-zero residual — p under q
+        # everywhere mass sits — degenerates to p, unreachable when a
+        # rejection actually happened there), row k draws the BONUS from
+        # p_k under the plain SAMPLE_TAG key at position p0 + k
+        resid = jnp.maximum(p_dist[:, :k] - q_probs, 0.0)
+        rsum = jnp.sum(resid, axis=-1, keepdims=True)
+        resid = jnp.where(rsum > 0, resid / jnp.maximum(rsum, 1e-38),
+                          p_dist[:, :k])
+        fall_rows = categorical_from_probs(
+            sampling_keys(
+                seeds[:, None] + jnp.zeros((1, k), jnp.uint32),
+                pos_mat[:, :k],
+                RESIDUAL_TAG,
+            ),
+            resid,
+        )  # (S, k)
+        bonus = categorical_from_probs(
+            sampling_keys(seeds, positions + k, SAMPLE_TAG),
+            p_dist[:, k],
+        )  # (S,)
+        fallback = jnp.concatenate([fall_rows, bonus[:, None]], axis=1)
+        final = jnp.take_along_axis(
+            fallback, n_accept[:, None], axis=1
+        )[:, 0]
+        return n_accept.astype(jnp.int32), final.astype(jnp.int32), new_pages
+
+    return jax.jit(verify, donate_argnums=_donate_cache())

@@ -41,10 +41,11 @@ __all__ = [
 
 def prefill_cost_args(bucket: int, block_size: int) -> tuple:
     """Abstract non-tree arguments of one paged-prefill invocation at
-    ``bucket`` tokens — ``(ids, length, block_row)`` shape structs for
-    the cost ledger's AOT lowering (``Engine.register_costs``). Shapes
-    mirror exactly what the live path passes, so the ledger's compiled
-    row IS the serving executable's cost, not a lookalike's."""
+    ``bucket`` tokens — ``(ids, length, block_row, temperature, top_p,
+    seed)`` shape structs for the cost ledger's AOT lowering
+    (``Engine.register_costs``). Shapes mirror exactly what the live
+    path passes, so the ledger's compiled row IS the serving
+    executable's cost, not a lookalike's."""
     import jax
     import jax.numpy as jnp
 
@@ -52,13 +53,17 @@ def prefill_cost_args(bucket: int, block_size: int) -> tuple:
         jax.ShapeDtypeStruct((1, bucket), jnp.int32),
         jax.ShapeDtypeStruct((), jnp.int32),
         jax.ShapeDtypeStruct((bucket // block_size,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.uint32),
     )
 
 
 def decode_cost_args(num_slots: int, blocks_per_slot: int) -> tuple:
-    """Abstract ``(block_table, tokens, positions)`` shape structs of
-    the ONE paged-decode executable (every occupancy/length mix runs
-    this same program — one ledger row covers all of serving decode)."""
+    """Abstract ``(block_table, tokens, positions, temperature, top_p,
+    seeds)`` shape structs of the ONE paged-decode executable (every
+    occupancy/length/sampling mix runs this same program — one ledger
+    row covers all of serving decode)."""
     import jax
     import jax.numpy as jnp
 
@@ -66,12 +71,16 @@ def decode_cost_args(num_slots: int, blocks_per_slot: int) -> tuple:
         jax.ShapeDtypeStruct((num_slots, blocks_per_slot), jnp.int32),
         jax.ShapeDtypeStruct((num_slots,), jnp.int32),
         jax.ShapeDtypeStruct((num_slots,), jnp.int32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.float32),
+        jax.ShapeDtypeStruct((num_slots,), jnp.uint32),
     )
 
 
 def make_paged_prefill_fn(dm: Any) -> Callable:
-    """``prefill(params, pages, ids (1, L), length, block_row (L//bs,))``
-    -> ``(first_token, last_logits (V,), new_pages)``.
+    """``prefill(params, pages, ids (1, L), length, block_row (L//bs,),
+    temperature, top_p, seed)`` -> ``(first_token, last_logits (V,),
+    new_pages)``.
 
     One executable per padded bucket length ``L`` (block-aligned by
     construction: the engine's paged buckets start at the block size).
@@ -80,16 +89,20 @@ def make_paged_prefill_fn(dm: Any) -> Callable:
     the prompt's K/V scatters to the physical block its table row names.
     ``block_row`` entries past the owned prefix are the trash block, so
     pad chunks never touch pages another slot owns; duplicate trash
-    indices are benign (last-write-wins over garbage).
+    indices are benign (last-write-wins over garbage). The first token
+    samples in-jit at fold position ``length - 1``
+    (:mod:`consensusml_tpu.serve.sampling`; ``temperature = 0`` = the
+    original greedy argmax).
     """
     import jax
     import jax.numpy as jnp
 
     from consensusml_tpu.serve.decode import _donate_cache
+    from consensusml_tpu.serve.sampling import sample_token
 
     model = dm.model
 
-    def prefill(params, pages, ids, length, block_row):
+    def prefill(params, pages, ids, length, block_row, temperature, top_p, seed):
         logits, kvs = model.apply(
             {"params": params}, ids, deterministic=True, return_kv=True
         )
@@ -111,30 +124,38 @@ def make_paged_prefill_fn(dm: Any) -> Callable:
                     "v": pg["v"].at[block_row].set(vr),
                 }
             )
-        return jnp.argmax(last).astype(jnp.int32), last, new_pages
+        tok = sample_token(
+            last[None], temperature[None], top_p[None], seed[None],
+            (length - 1)[None],
+        )[0]
+        return tok, last, new_pages
 
     return jax.jit(prefill, donate_argnums=_donate_cache())
 
 
 def make_paged_decode_fn(dm: Any) -> Callable:
     """``decode(params, pages, block_table (S, nb), tokens (S,),
-    positions (S,))`` -> ``(next_tokens (S,), new_pages)``.
+    positions (S,), temperature (S,), top_p (S,), seeds (S,))`` ->
+    ``(next_tokens (S,), new_pages)``.
 
     One token for ALL slots; each lane's write/read indices derive from
     its block-table row inside the jit
-    (:func:`consensusml_tpu.models.attention.paged_update_kv_cache`).
-    Occupancy, lengths, and block assignments are all DATA — one
-    executable serves every mix, the zero-recompile contract. Only the
-    pages donate; the block table is reused across steps.
+    (:func:`consensusml_tpu.models.attention.paged_update_kv_cache`),
+    and each lane samples under its own ``(seed, position)`` fold key
+    (:mod:`consensusml_tpu.serve.sampling`). Occupancy, lengths, block
+    assignments, AND sampling parameters are all DATA — one executable
+    serves every greedy/sampled mix, the zero-recompile contract. Only
+    the pages donate; the block table is reused across steps.
     """
     import jax
     import jax.numpy as jnp
 
     from consensusml_tpu.serve.decode import _donate_cache
+    from consensusml_tpu.serve.sampling import sample_token
 
     model = dm.model
 
-    def decode(params, pages, block_table, tokens, positions):
+    def decode(params, pages, block_table, tokens, positions, temperature, top_p, seeds):
         logits, new_pages = model.apply(
             {"params": params},
             tokens[:, None],
@@ -143,7 +164,10 @@ def make_paged_decode_fn(dm: Any) -> Callable:
             kv_cache=pages,
             block_table=block_table,
         )
-        return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), new_pages
+        toks = sample_token(
+            logits[:, 0], temperature, top_p, seeds, positions
+        )
+        return toks, new_pages
 
     return jax.jit(decode, donate_argnums=_donate_cache())
 

@@ -1,0 +1,148 @@
+"""In-jit token sampling: temperature/top-p with per-slot PRNG keys.
+
+Every sampling decision the serving engine makes happens INSIDE the
+compiled step (decode, prefill first-token, draft propose, speculative
+verify) — the host only ever passes three small per-slot arrays
+(``temperature``, ``top_p``, ``seed``) as DATA and fetches the sampled
+int32 tokens back. No host round-trips (cml-check's host-sync lint stays
+clean), no shape changes between greedy and sampled traffic (one
+executable serves any mix — the step-over-step canonical-jaxpr contract
+holds across sampled ticks), and greedy decoding is exactly the
+``temperature == 0`` special case of the same program.
+
+**The key schedule.** The token sampled from the logits row at absolute
+sequence position ``p`` of a request with per-request ``seed`` always
+uses::
+
+    fold_in(fold_in(PRNGKey(seed), p), tag)
+
+with ``tag = SAMPLE_TAG`` for ordinary next-token draws, ``ACCEPT_TAG``
+for speculative acceptance uniforms, and ``RESIDUAL_TAG`` for
+rejection-resampling draws. Keying on the request's own ``(seed,
+position)`` — not on slot index, engine step count, or batch
+composition — is what makes token streams **deterministically
+replayable** (same seed ⇒ same stream, regardless of what else is in
+flight) and what makes speculative decode with a draft that equals the
+target reproduce target-only sampling **bit for bit** (the
+distribution-equality fixture): the draft's proposal at position ``p``
+draws with exactly the key the plain decode step would have used.
+
+``jnp.float32`` throughout (the f64-promotion contract); the nucleus
+mask sorts the vocab once per row — O(V log V) inside a step that
+already runs an O(V·H) logits matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SAMPLE_TAG",
+    "ACCEPT_TAG",
+    "RESIDUAL_TAG",
+    "sampling_keys",
+    "adjusted_probs",
+    "categorical_from_probs",
+    "sample_token",
+]
+
+# fold-in tags separating the three independent random streams a
+# position can consume (draw / accept-uniform / residual re-draw)
+SAMPLE_TAG = 0
+ACCEPT_TAG = 1
+RESIDUAL_TAG = 2
+
+_PROB_FLOOR = 1e-38  # log() guard; masked entries stay exactly -inf
+
+
+def sampling_keys(
+    seeds: jax.Array, positions: jax.Array, tag: int
+) -> jax.Array:
+    """Per-slot PRNG keys: ``fold_in(fold_in(PRNGKey(seed), pos), tag)``
+    vmapped over the slot lane. ``seeds``/``positions`` may be any
+    matching shape; the key array has that shape."""
+    flat_seeds = seeds.reshape(-1)
+    flat_pos = positions.reshape(-1)
+
+    def one(seed, pos):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), pos), tag
+        )
+
+    keys = jax.vmap(one)(flat_seeds, flat_pos)
+    return keys.reshape(seeds.shape + keys.shape[1:])
+
+
+def adjusted_probs(
+    logits: jax.Array, temperature: jax.Array, top_p: jax.Array
+) -> jax.Array:
+    """The sampling distribution as explicit probabilities ``(..., V)``.
+
+    ``temperature > 0``: softmax of ``logits / temperature`` with the
+    nucleus (top-p) mask applied and renormalized — the smallest set of
+    highest-probability tokens whose mass reaches ``top_p`` keeps its
+    (renormalized) probability, everything else gets exactly 0.
+    ``temperature <= 0``: the greedy one-hot at ``argmax(logits)`` (ties
+    break to the lowest index, matching ``jnp.argmax`` — bit-compatible
+    with the engine's original greedy path).
+
+    Returning probabilities rather than sampled tokens is deliberate:
+    speculative verify needs the full target AND draft distributions for
+    the rejection-sampling acceptance ratio and the residual
+    ``max(p - q, 0)`` re-draw (docs/serving.md "Speculative decode").
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    t = jnp.where(temperature > 0, temperature, 1.0)[..., None]
+    probs = jax.nn.softmax(logits / t, axis=-1)
+    # nucleus mask: tokens whose cumulative mass BEFORE them is < top_p
+    # survive (the top token always does: its prefix mass is 0)
+    p_keep = jnp.clip(top_p, 1e-6, 1.0)[..., None]
+    order = jnp.argsort(-logits, axis=-1)
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    prefix = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    keep_sorted = prefix < p_keep
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    masked = jnp.where(keep, probs, 0.0)
+    masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    greedy = jax.nn.one_hot(
+        jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32
+    )
+    return jnp.where((temperature > 0)[..., None], masked, greedy)
+
+
+def categorical_from_probs(keys: jax.Array, probs: jax.Array) -> jax.Array:
+    """Sample one token id per row from explicit probabilities.
+
+    Zero-probability entries are exactly ``-inf`` in the gumbel race so
+    a masked token can never win; a greedy one-hot row therefore returns
+    its argmax deterministically, key regardless — which is what lets
+    ONE program serve greedy and sampled lanes side by side.
+    """
+    logp = jnp.where(
+        probs > 0, jnp.log(jnp.maximum(probs, _PROB_FLOOR)), -jnp.inf
+    )
+    flat_keys = keys.reshape(-1, *keys.shape[len(probs.shape) - 1:])
+    flat_logp = logp.reshape(-1, logp.shape[-1])
+    toks = jax.vmap(jax.random.categorical)(flat_keys, flat_logp)
+    return toks.reshape(probs.shape[:-1]).astype(jnp.int32)
+
+
+def sample_token(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    seeds: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Next-token draw for ``logits (..., V)`` rows at their absolute
+    ``positions``, under the canonical key schedule (``SAMPLE_TAG``).
+    The single entry point the decode / prefill / verify-bonus paths
+    share, so every path that samples "the token after position p" is
+    bit-identical by construction."""
+    probs = adjusted_probs(logits, temperature, top_p)
+    keys = sampling_keys(seeds, positions, SAMPLE_TAG)
+    return categorical_from_probs(keys, probs)

@@ -4,18 +4,25 @@ Line-delimited JSON over TCP — deliberately minimal (no HTTP dependency
 in this environment) but shaped like a real serving front-end:
 
 request (one line; ``trace_id``/``request_id`` are optional — anything
-missing is minted server-side, so every request is traceable)::
+missing is minted server-side, so every request is traceable; the
+sampling triple ``temperature``/``top_p``/``seed`` and the per-request
+``eos_id`` stop override are optional too — omitted fields take the
+engine's ``ServeConfig`` defaults)::
 
     {"ids": [3, 17, 42], "max_new_tokens": 16,
+     "temperature": 0.8, "top_p": 0.95, "seed": 12345, "eos_id": 50256,
      "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
 response (streamed, one line per token, then a terminal record echoing
-the trace identity so client and server observations join on it)::
+the trace identity AND the resolved sampling triple — resubmitting with
+the echoed seed replays the exact token stream)::
 
     {"token": 7}
     {"token": 19}
     {"done": true, "tokens": [7, 19, ...], "finish_reason": "max_tokens",
      "ttft_ms": 12.3, "latency_ms": 48.9,
+     "temperature": 0.8, "top_p": 0.95, "seed": 12345,
+     "spec_proposed": 12, "spec_accepted": 9,
      "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
 errors land as ``{"error": "..."}`` and close the connection. One
@@ -117,7 +124,11 @@ class ServeServer:
                             req["trace_id"], req.get("request_id")
                         )
                     handle = self.engine.submit(
-                        req["ids"], req.get("max_new_tokens"), trace=trace
+                        req["ids"], req.get("max_new_tokens"), trace=trace,
+                        temperature=req.get("temperature"),
+                        top_p=req.get("top_p"),
+                        seed=req.get("seed"),
+                        eos_id=req.get("eos_id"),
                     )
                 except Exception as e:  # bad JSON, validation, draining
                     f.write(json.dumps({"error": str(e)}).encode() + b"\n")
@@ -135,6 +146,11 @@ class ServeServer:
                             "finish_reason": r.finish_reason,
                             "ttft_ms": round(1e3 * r.ttft_s, 3),
                             "latency_ms": round(1e3 * r.latency_s, 3),
+                            "temperature": r.temperature,
+                            "top_p": r.top_p,
+                            "seed": r.seed,
+                            "spec_proposed": r.spec_proposed,
+                            "spec_accepted": r.spec_accepted,
                             "trace_id": r.trace_id,
                             "request_id": r.request_id,
                         }

@@ -26,8 +26,8 @@ def test_default_spec_is_well_formed():
     mod = _tool()
     assert mod.DEFAULT_SPEC
     for entry in mod.DEFAULT_SPEC:
-        assert entry["direction"] in ("up", "down", "max")
-        if entry["direction"] == "max":
+        assert entry["direction"] in ("up", "down", "max", "min")
+        if entry["direction"] in ("max", "min"):
             assert "bound" in entry
         else:
             assert entry.get("tol_pct", 0) >= 0
@@ -41,8 +41,49 @@ def test_default_spec_is_well_formed():
     assert "attribution.attribution_overhead_pct" in keys
     assert "attribution.expected_vs_measured_missing" in keys
     for exe in ("train_step", "gossip_round", "serve_decode",
-                "serve_prefill_max"):
+                "serve_prefill_max", "spec_propose", "spec_verify"):
         assert f"attribution.compile_ms.{exe}" in keys
+    # the speculative serving block (ISSUE 13): gain floor + trajectory
+    # direction, acceptance floor, zero-recompile gates on both engines
+    assert "serving.spec.spec_tokens_per_sec_gain" in keys
+    assert "serving.spec.spec.acceptance_rate" in keys
+    assert "serving.spec.spec.zero_recompiles_after_warmup" in keys
+    assert "serving.spec.baseline.zero_recompiles_after_warmup" in keys
+
+
+def test_min_direction_enforces_floors(tmp_path, capsys):
+    """A fresh bench whose speculative block loses its tokens/s gain,
+    acceptance floor, or zero-recompile gate fails; a healthy block
+    passes. Booleans gate as min-1 floors (true == 1)."""
+    mod = _tool()
+
+    def run(spec_block):
+        fresh = {
+            "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+            "serving": {"spec": spec_block},
+        }
+        path = tmp_path / "fresh.json"
+        path.write_text(json.dumps(fresh))
+        rc = mod.main([str(path), "--repo-root", REPO])
+        return rc, capsys.readouterr().out
+
+    healthy = {
+        "spec_tokens_per_sec_gain": 2.3,
+        "baseline": {"zero_recompiles_after_warmup": True},
+        "spec": {
+            "acceptance_rate": 1.0,
+            "zero_recompiles_after_warmup": True,
+        },
+    }
+    rc, _out = run(healthy)
+    assert rc == 0
+    bad = json.loads(json.dumps(healthy))
+    bad["spec_tokens_per_sec_gain"] = 1.1  # floor is 1.5
+    bad["spec"]["acceptance_rate"] = 0.5  # proxy floor is 0.95
+    bad["spec"]["zero_recompiles_after_warmup"] = False
+    rc, out = run(bad)
+    assert rc == 1
+    assert "below the absolute floor" in out
 
 
 def test_attribution_budgets_enforced_on_fresh_result(tmp_path, capsys):

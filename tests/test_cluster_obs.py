@@ -348,7 +348,7 @@ def test_loadgen_metrics_merge_with_rank_snapshots(tmp_path):
     lg = _tool("loadgen")
     from consensusml_tpu.obs import get_registry
 
-    def submit(ids, max_new, ctx):
+    def submit(ids, max_new, ctx, sampling=None):
         return {"ttft_s": 0.01, "latency_s": 0.05, "tokens": [1] * max_new}
 
     report = lg.run_loadgen(

@@ -171,12 +171,19 @@ def test_paged_stages_bitexact_vs_slot_path(family):
     ids = np.zeros((1, bucket), np.int32)
     ids[0, :6] = np.asarray(prompt)
 
+    greedy1 = (jnp.float32(0.0), jnp.float32(1.0), jnp.uint32(0))
+    greedy = (
+        jnp.zeros((slots,), jnp.float32),
+        jnp.ones((slots,), jnp.float32),
+        jnp.zeros((slots,), jnp.uint32),
+    )
     # slot path (PR 5)
     cache = D.init_cache(dm, slots, max_len)
     slot_prefill = D.make_prefill_fn(dm)
     slot_decode = D.make_decode_fn(dm)
     tok_s, logits_s, cache = slot_prefill(
-        params, cache, jnp.asarray(ids), jnp.int32(6), jnp.int32(0)
+        params, cache, jnp.asarray(ids), jnp.int32(6), jnp.int32(0),
+        *greedy1,
     )
 
     # paged path (pool)
@@ -188,6 +195,7 @@ def test_paged_stages_bitexact_vs_slot_path(family):
     tok_p, logits_p, pages = paged_prefill(
         params, pages, jnp.asarray(ids), jnp.int32(6),
         jnp.asarray(pool.block_row(0, bucket // bs)),
+        *greedy1,
     )
     assert int(tok_s) == int(tok_p)
     np.testing.assert_array_equal(np.asarray(logits_s), np.asarray(logits_p))
@@ -201,10 +209,13 @@ def test_paged_stages_bitexact_vs_slot_path(family):
             pool.extend(0, 1)
         tokens_s = jnp.zeros((slots,), jnp.int32).at[0].set(tok_sc)
         positions = jnp.zeros((slots,), jnp.int32).at[0].set(pos)
-        out_s, cache = slot_decode(params, cache, tokens_s, positions)
+        out_s, cache = slot_decode(
+            params, cache, tokens_s, positions, *greedy
+        )
         tokens_p = jnp.zeros((slots,), jnp.int32).at[0].set(tok_pc)
         out_p, pages = paged_decode(
-            params, pages, pool.device_table(), tokens_p, positions
+            params, pages, pool.device_table(), tokens_p, positions,
+            *greedy,
         )
         toks_s, toks_p = int(out_s[0]), int(out_p[0])
         assert toks_s == toks_p, f"divergence at decode step {step}"
@@ -286,7 +297,11 @@ def test_engine_eviction_recompute_completes_all_streams():
         np.random.default_rng(i).integers(0, 63, size=4 + 3 * i).tolist()
         for i in range(4)
     ]
-    max_new = 8
+    # 16 generated tokens per stream: peak demand is 14 blocks (3+3+4+4)
+    # against the tight pool's 9 usable, so eviction pressure is
+    # STRUCTURAL — it cannot be raced away by one stream finishing
+    # before another is admitted on a slow, loaded box
+    max_new = 16
 
     def serve(num_blocks):
         cfg = ServeConfig(
@@ -372,6 +387,7 @@ def test_watcher_stages_new_generations_and_rejects_backwards(tmp_path):
     from consensusml_tpu.obs import get_registry
 
     w.path, w.poll_s, w.generation = art, 999.0, 1
+    w.stage_draft = False
     w._loader, w._staged, w._lock = loader, None, threading.Lock()
     w._rejected_gen, w._flip_rejected = None, None
     reg = get_registry()

@@ -1,0 +1,450 @@
+"""In-jit sampling + speculative decode on the paged engine (ISSUE 13).
+
+The pinned properties:
+
+- **Sampling semantics** — temperature 0 is bit-compatible greedy argmax
+  of the SAME executable; top-p masks to the nucleus; streams are a pure
+  function of ``(seed, position)`` so replay is deterministic.
+- **Distribution equality** — speculative decode with a draft that IS
+  the target reproduces target-only sampling BIT FOR BIT under the
+  shared key schedule (both model families, sampled and greedy), with
+  acceptance exactly 1.0 and zero recompiles after warmup.
+- **Rollback invariants** — under a real (disagreeing) draft, rejected
+  suffixes roll back by host bookkeeping only: ``BlockPool.check()``
+  holds through randomized accept/reject churn, tight pools preempt
+  mid-draft streams by recompute and every stream still completes.
+- **Protocol + artifacts** — per-request ``temperature``/``top_p``/
+  ``seed``/``eos_id`` ride the line-JSON wire and echo on the terminal
+  record; ``export_draft`` installs the draft artifact the hot-swap
+  watcher restages with the parent generation.
+"""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.serve import Engine, ServeConfig, SpecConfig
+from consensusml_tpu.serve import decode as D
+from consensusml_tpu.serve import pool as P
+
+pytestmark = pytest.mark.serving
+
+
+def _tiny_gpt2(**over):
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    kw = dict(
+        vocab_size=64, hidden=32, layers=2, heads=2, max_len=32, dropout=0.0
+    )
+    kw.update(over)
+    return GPT2LM(config=GPT2Config(**kw))
+
+
+def _tiny_llama():
+    from consensusml_tpu.models.llama import llama_tiny
+
+    return llama_tiny(max_len=32)
+
+
+def _init(model, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def _draft_pair():
+    """A target and a genuinely DIFFERENT (cheaper, disagreeing) draft."""
+    target = _tiny_gpt2()
+    draft = _tiny_gpt2(hidden=16, layers=1)
+    return target, _init(target), draft, _init(draft, seed=1)
+
+
+# ---------------------------------------------------------------------------
+# Sampling unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_adjusted_probs_greedy_topp_and_determinism():
+    from consensusml_tpu.serve.sampling import (
+        adjusted_probs,
+        sample_token,
+    )
+
+    logits = jnp.asarray([[2.0, 1.0, 0.5, -1.0], [0.0, 3.0, 2.9, -2.0]])
+    # temperature 0: exact one-hot at argmax
+    greedy = adjusted_probs(
+        logits, jnp.zeros((2,)), jnp.ones((2,))
+    )
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(greedy), -1), [0, 1]
+    )
+    assert np.asarray(greedy).max() == 1.0
+    # top-p keeps the smallest prefix reaching the mass; the rest is 0
+    nucleus = np.asarray(
+        adjusted_probs(logits, jnp.ones((2,)), jnp.full((2,), 0.5))
+    )
+    assert nucleus[0, 3] == 0.0 and nucleus[1, 3] == 0.0
+    np.testing.assert_allclose(nucleus.sum(-1), 1.0, rtol=1e-6)
+    # greedy sampling through the categorical is argmax, key regardless
+    seeds = jnp.asarray([7, 8], jnp.uint32)
+    pos = jnp.asarray([3, 9], jnp.int32)
+    toks = sample_token(logits, jnp.zeros((2,)), jnp.ones((2,)), seeds, pos)
+    np.testing.assert_array_equal(np.asarray(toks), [0, 1])
+    # sampled draws are a pure function of (seed, position)
+    t1 = sample_token(logits, jnp.ones((2,)), jnp.ones((2,)), seeds, pos)
+    t2 = sample_token(logits, jnp.ones((2,)), jnp.ones((2,)), seeds, pos)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    t3 = sample_token(
+        logits, jnp.ones((2,)), jnp.ones((2,)), seeds + 1, pos
+    )
+    assert not np.array_equal(np.asarray(t1), np.asarray(t3))
+
+
+def test_sampled_engine_streams_replay_deterministically():
+    model = _tiny_gpt2()
+    params = _init(model)
+
+    def stream():
+        with Engine(
+            model, params, ServeConfig(num_slots=2, max_len=32)
+        ) as eng:
+            eng.warmup()
+            r = eng.submit(
+                [3, 9, 2], 8, temperature=0.9, top_p=0.8, seed=1234
+            ).result(timeout=60)
+            assert (r.temperature, r.top_p, r.seed) == (0.9, 0.8, 1234)
+            return r.tokens
+
+    first = stream()
+    assert stream() == first
+    # greedy default (no sampling args) stays the argmax path
+    with Engine(model, params, ServeConfig(num_slots=2, max_len=32)) as eng:
+        eng.warmup()
+        g1 = eng.submit([3, 9, 2], 8).result(timeout=60)
+        g2 = eng.submit([3, 9, 2], 8).result(timeout=60)
+    assert g1.tokens == g2.tokens and g1.temperature == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Distribution equality: spec(self-draft) == target-only, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("temperature", [0.0, 0.7])
+def test_spec_self_draft_matches_plain_bit_for_bit(family, temperature):
+    """The acceptance fixture: with draft == target, every proposal draws
+    under exactly the key the plain path would use and every acceptance
+    ratio is 1, so the speculative stream equals the target-only stream
+    BIT FOR BIT — sampled and greedy, both families — at acceptance 1.0
+    with zero recompiles after warmup."""
+    model = _tiny_gpt2() if family == "gpt2" else _tiny_llama()
+    params = _init(model)
+    rng = np.random.default_rng(7)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab - 1, size=n).tolist() for n in (2, 5, 9, 13)]
+
+    def serve(spec):
+        with Engine(
+            model, params,
+            ServeConfig(num_slots=4, max_len=32, kv_impl="paged"),
+            spec_decode=spec,
+        ) as eng:
+            warm = eng.warmup()
+            handles = [
+                eng.submit(
+                    p, 8, temperature=temperature, top_p=0.9, seed=100 + i
+                )
+                for i, p in enumerate(prompts)
+            ]
+            results = [h.result(timeout=120) for h in handles]
+            return results, warm, eng.stats()
+
+    plain, _, _ = serve(None)
+    spec, warm, stats = serve(SpecConfig(model=model, params=params, k=3))
+    assert [r.tokens for r in plain] == [r.tokens for r in spec]
+    assert stats["spec"]["acceptance_rate"] == 1.0
+    assert stats["compile_counts"] == warm  # zero recompiles after warmup
+    # per-stream accounting echoes on the terminal record
+    for r in spec:
+        assert r.spec_proposed > 0 and r.spec_accepted == r.spec_proposed
+
+
+# ---------------------------------------------------------------------------
+# Acceptance-rate counter semantics
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_counters_and_request_traces():
+    target, tparams, draft, dparams = _draft_pair()
+    with Engine(
+        target, tparams,
+        ServeConfig(num_slots=2, max_len=32, kv_impl="paged"),
+        spec_decode=SpecConfig(model=draft, params=dparams, k=4),
+    ) as eng:
+        eng.warmup()
+        handles = [
+            eng.submit([1 + i, 5, 9], 8, temperature=1.2, seed=i)
+            for i in range(4)
+        ]
+        results = [h.result(timeout=120) for h in handles]
+        stats = eng.stats()
+    spec = stats["spec"]
+    assert spec["rounds"] > 0
+    # proposed counts k per live lane per round; accepted never exceeds it
+    assert 0 <= spec["accepted"] <= spec["proposed"]
+    assert spec["proposed"] <= spec["k"] * spec["rounds"] * 2  # <= k*rounds*lanes
+    assert spec["acceptance_rate"] == pytest.approx(
+        spec["accepted"] / spec["proposed"]
+    )
+    # the per-request split sums to the engine totals and rides the trace
+    assert sum(r.spec_proposed for r in results) == spec["proposed"]
+    assert sum(r.spec_accepted for r in results) == spec["accepted"]
+    from consensusml_tpu.obs import get_request_registry
+
+    done = {
+        t.request_id: t for t in get_request_registry().completed()
+    }
+    for r in results:
+        tr = done.get(r.request_id)
+        if tr is None:
+            continue  # ring shared with other tests may have evicted it
+        assert tr.spec_proposed == r.spec_proposed
+        assert tr.spec_accepted == r.spec_accepted
+        assert tr.to_dict()["spec_accepted"] == r.spec_accepted
+
+
+# ---------------------------------------------------------------------------
+# Rollback-on-reject pool invariants + mid-draft preemption
+# ---------------------------------------------------------------------------
+
+
+def test_block_pool_shrink_rollback_invariants():
+    pool = P.BlockPool(num_slots=2, max_len=32, block_size=8)
+    pool.alloc(0, 1)
+    pool.extend(0, 3)  # speculative window over-allocation
+    assert len(pool.owned(0)) == 4
+    freed = pool.shrink(0, 2)  # rejected suffix hands the tail back
+    assert len(freed) == 2 and len(pool.owned(0)) == 2
+    pool.check()
+    # table rows past the kept prefix reset to trash
+    assert list(pool.block_row(0, 4)[2:]) == [P.TRASH_BLOCK] * 2
+    assert pool.shrink(0, 2) == []  # idempotent
+    with pytest.raises(ValueError, match="keep_blocks"):
+        pool.shrink(0, 0)
+    with pytest.raises(RuntimeError, match="owns nothing"):
+        pool.shrink(1, 1)
+    pool.release(0)
+    pool.check()
+
+
+def test_spec_randomized_churn_holds_pool_invariants():
+    """Randomized accept/reject churn (a disagreeing draft at high
+    temperature) across admissions, growth, rollback, and release —
+    the free ∪ owned partition proof must hold throughout."""
+    target, tparams, draft, dparams = _draft_pair()
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(0, 63, size=2 + int(rng.integers(0, 10))).tolist()
+        for _ in range(12)
+    ]
+    eng = Engine(
+        target, tparams,
+        ServeConfig(num_slots=4, max_len=32, kv_impl="paged"),
+        spec_decode=SpecConfig(model=draft, params=dparams, k=3),
+    )
+    try:
+        eng.warmup()
+        handles = [
+            eng.submit(p, 9, temperature=1.5, top_p=0.9, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        results = [h.result(timeout=180) for h in handles]
+        assert all(len(r.tokens) == 9 for r in results)
+        eng._pool.check()
+        stats = eng.stats()
+        assert 0.0 < stats["spec"]["acceptance_rate"] < 1.0  # real churn
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_tight_pool_preempts_mid_draft_stream_by_recompute():
+    """A pool too small for the speculative windows preempts the
+    youngest stream BETWEEN rounds (blocks freed, prompt + generated
+    re-enqueued); every stream still completes with its full token
+    count, and the preempted trace records the recompute."""
+    target, tparams, draft, dparams = _draft_pair()
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 63, size=n).tolist() for n in (2, 4, 7, 9, 12, 5)]
+    eng = Engine(
+        target, tparams,
+        ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", num_blocks=9
+        ),
+        spec_decode=SpecConfig(model=draft, params=dparams, k=4),
+    )
+    try:
+        eng.warmup()
+        handles = [
+            eng.submit(p, 10, temperature=0.9, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        results = [h.result(timeout=180) for h in handles]
+        assert all(len(r.tokens) == 10 for r in results)
+        assert eng.stats()["evictions"] > 0  # pressure actually happened
+        eng._pool.check()
+    finally:
+        eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol: sampling fields + echo, per-request eos
+# ---------------------------------------------------------------------------
+
+
+def test_line_json_carries_sampling_fields_and_echoes():
+    from consensusml_tpu.serve.server import ServeServer
+
+    model = _tiny_gpt2()
+    params = _init(model)
+    engine = Engine(model, params, ServeConfig(num_slots=2, max_len=32))
+    engine.warmup()
+    server = ServeServer(engine)
+    try:
+        def ask(payload):
+            with socket.create_connection(server.address, timeout=60) as c:
+                f = c.makefile("rwb")
+                f.write(json.dumps(payload).encode() + b"\n")
+                f.flush()
+                toks, done = [], None
+                for line in f:
+                    msg = json.loads(line)
+                    if msg.get("done"):
+                        done = msg
+                        break
+                    toks.append(msg["token"])
+                return toks, done
+
+        req = {
+            "ids": [4, 8, 15], "max_new_tokens": 6,
+            "temperature": 0.8, "top_p": 0.9, "seed": 777,
+        }
+        toks1, done1 = ask(req)
+        toks2, done2 = ask(req)
+        assert toks1 == toks2 == done1["tokens"]  # replay on the wire
+        assert done1["temperature"] == 0.8
+        assert done1["top_p"] == 0.9
+        assert done1["seed"] == 777
+        assert done1["spec_proposed"] == 0  # non-speculative engine
+        # per-request eos override: stop exactly at the chosen token
+        eos = toks1[2]
+        toks3, done3 = ask(dict(req, eos_id=eos))
+        assert done3["finish_reason"] == "eos"
+        assert toks3 == toks1[: toks3.index(eos) + 1]
+    finally:
+        server.shutdown(drain=False)
+
+
+def test_submit_validates_sampling_args():
+    model = _tiny_gpt2()
+    eng = Engine(model, _init(model), ServeConfig(num_slots=1, max_len=32))
+    try:
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1], 2, temperature=-0.5)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=1.5)
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_spec_requires_paged_and_matching_vocab():
+    target, tparams, draft, dparams = _draft_pair()
+    with pytest.raises(ValueError, match="paged"):
+        Engine(
+            target, tparams,
+            ServeConfig(num_slots=1, max_len=32, kv_impl="slot"),
+            spec_decode=SpecConfig(model=draft, params=dparams, k=2),
+        )
+    other = _tiny_gpt2(vocab_size=32)
+    with pytest.raises(ValueError, match="vocab"):
+        Engine(
+            target, tparams,
+            ServeConfig(num_slots=1, max_len=32),
+            spec_decode=SpecConfig(
+                model=other, params=_init(other), k=2
+            ),
+        )
+    with pytest.raises(ValueError, match="k must be"):
+        SpecConfig(model=draft, params=dparams, k=0)
+
+
+# ---------------------------------------------------------------------------
+# Draft artifact + hot-swap pair staging
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_export_draft_load_engine_and_pair_hot_swap(tmp_path):
+    """The draft rides the parent artifact's generation protocol:
+    ``export_draft`` installs ``draft/``, ``load_engine(spec_k=...)``
+    builds the speculative engine from the pair, and a generation bump
+    restages + flips target AND draft together mid-traffic with zero
+    recompiles."""
+    from consensusml_tpu import configs
+    from consensusml_tpu.serve.export import (
+        bump_generation,
+        export_draft,
+        export_serving,
+        serving_meta,
+    )
+    from consensusml_tpu.train import init_stacked_state
+
+    bundle = configs.build("gpt2_topk", "smoke")
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(0), bundle.world_size
+    )
+    art = str(tmp_path / "art")
+    export_serving(art, state, config_name="gpt2_topk", scale="smoke")
+    # self-draft artifact: same config params (acceptance 1.0 fixture)
+    from consensusml_tpu.serve.export import load_serving
+
+    _meta, params, _ms = load_serving(art)
+    export_draft(art, params, config_name="gpt2_topk", scale="smoke")
+    assert serving_meta(art + "/draft")["role"] == "draft"
+
+    from consensusml_tpu.serve import load_engine
+
+    eng = load_engine(
+        art,
+        ServeConfig(num_slots=2, max_len=32, max_new_tokens=6),
+        spec_k=2,
+    )
+    try:
+        warm = eng.warmup()
+        r1 = eng.submit([3, 7, 11], 6).result(timeout=120)
+        assert len(r1.tokens) == 6
+        watcher = eng.watch(art, poll_s=0.05)
+        assert watcher.stage_draft
+        gen0 = eng.generation
+        bump_generation(art)
+        # serve across the swap; the flip lands between rounds
+        import time as _time
+
+        deadline = _time.time() + 60
+        while eng.generation == gen0 and _time.time() < deadline:
+            eng.submit([3, 7, 11], 6).result(timeout=120)
+            _time.sleep(0.05)
+        assert eng.generation == gen0 + 1
+        stats = eng.stats()
+        assert stats["swaps"] >= 1
+        assert stats["compile_counts"] == warm  # pair flip recompiled nothing
+        assert stats["spec"]["acceptance_rate"] == 1.0  # draft == target
+    finally:
+        eng.shutdown(drain=False)

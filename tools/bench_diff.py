@@ -19,7 +19,11 @@ one entry per metric):
   ``fresh > ref * (1 + tol_pct/100)`` (e.g. a ttft_p99_ms);
 - ``direction: "max"``  — absolute budget, no reference needed;
   regression when ``fresh > bound`` (e.g. the observability plane's
-  overhead_pct must stay under 1%).
+  overhead_pct must stay under 1%);
+- ``direction: "min"``  — absolute floor, no reference needed;
+  regression when ``fresh < bound`` (e.g. the speculative serving
+  block's tokens/s gain and acceptance rate, and boolean gates like
+  ``zero_recompiles_after_warmup`` where ``true`` must stay ``true``).
 
 ``key`` is a dotted path: top-level keys (``value``, ``vs_baseline``)
 resolve in the compact record, dotted keys (``observability.
@@ -80,6 +84,28 @@ DEFAULT_SPEC = [
     {"key": "attribution.compile_ms.serve_decode", "direction": "max",
      "bound": 60000.0},
     {"key": "attribution.compile_ms.serve_prefill_max", "direction": "max",
+     "bound": 60000.0},
+    # speculative serving block (ISSUE 13, docs/serving.md "Speculative
+    # decode"): the greedy CPU proxy's decode-tokens/s gain must hold
+    # (trajectory-relative once archived, absolute floor always), the
+    # proxy's acceptance rate is ~1.0 by construction (a drop means the
+    # draft/verify key schedule or acceptance math regressed, not the
+    # box), both engines must stay zero-recompile after warmup, and the
+    # two new spec executables get the same absolute compile walls as
+    # the other serving programs
+    {"key": "serving.spec.spec_tokens_per_sec_gain", "direction": "min",
+     "bound": 1.5},
+    {"key": "serving.spec.spec_tokens_per_sec_gain", "direction": "up",
+     "tol_pct": 30.0},
+    {"key": "serving.spec.spec.acceptance_rate", "direction": "min",
+     "bound": 0.95},
+    {"key": "serving.spec.spec.zero_recompiles_after_warmup",
+     "direction": "min", "bound": 1.0},
+    {"key": "serving.spec.baseline.zero_recompiles_after_warmup",
+     "direction": "min", "bound": 1.0},
+    {"key": "attribution.compile_ms.spec_propose", "direction": "max",
+     "bound": 60000.0},
+    {"key": "attribution.compile_ms.spec_verify", "direction": "max",
      "bound": 60000.0},
 ]
 
@@ -152,15 +178,18 @@ def diff(fresh: dict, ref: dict | None, spec: list[dict]) -> dict:
             "ref": None,
             "status": "ok",
         }
-        if direction == "max":
+        if direction in ("max", "min"):
             bound = float(entry["bound"])
             row["bound"] = bound
             if fv is None:
                 row["status"] = "skipped"
                 row["why"] = "metric absent from fresh result"
-            elif fv > bound:
+            elif direction == "max" and fv > bound:
                 row["status"] = "regression"
                 row["why"] = f"{fv:g} exceeds the absolute budget {bound:g}"
+            elif direction == "min" and fv < bound:
+                row["status"] = "regression"
+                row["why"] = f"{fv:g} is below the absolute floor {bound:g}"
         else:
             tol = float(entry.get("tol_pct", 0.0))
             rv = _get_path(ref, key) if ref else None
@@ -205,11 +234,13 @@ def render_text(report: dict, provenance: list[str]) -> str:
         mark = {"ok": "ok  ", "skipped": "skip", "regression": "FAIL"}[
             r["status"]
         ]
-        ref = (
-            f" vs {r['ref']:g} ±{r.get('tol_pct', 0):g}%"
-            if r.get("ref") is not None
-            else (f" <= {r['bound']:g}" if "bound" in r else "")
-        )
+        if r.get("ref") is not None:
+            ref = f" vs {r['ref']:g} ±{r.get('tol_pct', 0):g}%"
+        elif "bound" in r:
+            op = ">=" if r["direction"] == "min" else "<="
+            ref = f" {op} {r['bound']:g}"
+        else:
+            ref = ""
         fresh = "-" if r["fresh"] is None else f"{r['fresh']:g}"
         lines.append(
             f"[{mark}] {r['key']:<42} {r['direction']:>4}  {fresh}{ref}"

@@ -71,18 +71,27 @@ def run_loadgen(
     len_dist: str = "uniform",
     swap_every: int = 0,
     swap_fn=None,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
 ) -> dict:
-    """Open-loop driver over any ``submit(ids, max_new, ctx) ->
-    result_dict`` callable (``result_dict``: ``ttft_s``, ``latency_s``,
-    ``tokens``; ``ctx`` is the minted
+    """Open-loop driver over any ``submit(ids, max_new, ctx, sampling)
+    -> result_dict`` callable (``result_dict``: ``ttft_s``,
+    ``latency_s``, ``tokens``; ``ctx`` is the minted
     :class:`~consensusml_tpu.obs.TraceContext` the submitter should
-    propagate so the server's trace joins the client's observation).
-    Each arrival runs on its own thread so a slow request never delays
-    the next arrival (that is what makes the loop open). With
-    ``swap_every`` + ``swap_fn``, every ``swap_every``-th arrival first
-    triggers ``swap_fn()`` (the hot-swap poke: bump the artifact's
-    generation mid-traffic) — tail latency under live reload is part of
-    the SLO story, not a separate benchmark."""
+    propagate so the server's trace joins the client's observation;
+    ``sampling`` is the per-request ``temperature``/``top_p``/``seed``
+    dict the submitter forwards on the wire). Each arrival runs on its
+    own thread so a slow request never delays the next arrival (that is
+    what makes the loop open). With ``swap_every`` + ``swap_fn``, every
+    ``swap_every``-th arrival first triggers ``swap_fn()`` (the hot-swap
+    poke: bump the artifact's generation mid-traffic) — tail latency
+    under live reload is part of the SLO story, not a separate
+    benchmark.
+
+    Per-request seeds derive deterministically from ``(seed, arrival
+    index)`` — like the trace ids — so a fixture replays to the SAME
+    sampled token streams end to end (the engine's ``(seed, position)``
+    fold keys make the stream a pure function of the request)."""
     from consensusml_tpu.obs import TraceContext
 
     rng = np.random.default_rng(seed)
@@ -93,9 +102,9 @@ def run_loadgen(
     threads = []
     swaps = 0
 
-    def one(ids, ctx):
+    def one(ids, ctx, sampling):
         try:
-            r = submit(ids, max_new_tokens, ctx)
+            r = submit(ids, max_new_tokens, ctx, sampling)
             r.setdefault("trace_id", ctx.trace_id)
             r.setdefault("request_id", ctx.request_id)
             with lock:
@@ -115,7 +124,15 @@ def run_loadgen(
         # fixture replays to the same ids, and client + server sides of
         # one request join on trace_id (docs/observability.md)
         ctx = TraceContext(f"lg{seed:x}-{i:05d}")
-        t = threading.Thread(target=one, args=(list(map(int, ids)), ctx))
+        sampling = {
+            "temperature": temperature,
+            "top_p": top_p,
+            # 32-bit per-request seed, disjoint across fixture seeds
+            "seed": ((seed << 20) ^ i) & 0xFFFFFFFF,
+        }
+        t = threading.Thread(
+            target=one, args=(list(map(int, ids)), ctx, sampling)
+        )
         threads.append(t)
         t.start()
         # exponential inter-arrival gap == Poisson arrivals
@@ -148,6 +165,11 @@ def run_loadgen(
         "errors": len(errors),
         "error_sample": errors[:3],
         "len_dist": len_dist,
+        "temperature": temperature,
+        "top_p": top_p,
+        # speculative-decode roll-up (0/0 against a non-spec engine)
+        "spec_proposed": int(sum(r.get("spec_proposed", 0) for r in results)),
+        "spec_accepted": int(sum(r.get("spec_accepted", 0) for r in results)),
         "swaps_triggered": swaps,
         "offered_rate_rps": rate_rps,
         "achieved_rps": len(results) / wall if wall > 0 else 0.0,
@@ -209,21 +231,31 @@ def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
 
 
 def _engine_submit(engine):
-    def submit(ids, max_new, ctx=None):
-        h = engine.submit(ids, max_new, trace=ctx)
+    def submit(ids, max_new, ctx=None, sampling=None):
+        s = sampling or {}
+        h = engine.submit(
+            ids, max_new, trace=ctx,
+            temperature=s.get("temperature"), top_p=s.get("top_p"),
+            seed=s.get("seed"),
+        )
         r = h.result(timeout=300)
         return {
             "ttft_s": r.ttft_s, "latency_s": r.latency_s, "tokens": r.tokens,
             "trace_id": r.trace_id, "request_id": r.request_id,
+            "temperature": r.temperature, "top_p": r.top_p, "seed": r.seed,
+            "spec_proposed": r.spec_proposed,
+            "spec_accepted": r.spec_accepted,
         }
 
     return submit
 
 
 def _socket_submit(host: str, port: int):
-    def submit(ids, max_new, ctx=None):
+    def submit(ids, max_new, ctx=None, sampling=None):
         t0 = time.perf_counter()
         req = {"ids": ids, "max_new_tokens": max_new}
+        if sampling:
+            req.update(sampling)
         if ctx is not None:
             req["trace_id"] = ctx.trace_id
             req["request_id"] = ctx.request_id
@@ -243,9 +275,15 @@ def _socket_submit(host: str, port: int):
                         "latency_s": time.perf_counter() - t0,
                         "tokens": msg["tokens"],
                         # server-echoed identity (joins on trace_id even
-                        # if the server minted its own request_id)
+                        # if the server minted its own request_id) and
+                        # resolved sampling triple (replay contract)
                         "trace_id": msg.get("trace_id", ""),
                         "request_id": msg.get("request_id", ""),
+                        "temperature": msg.get("temperature", 0.0),
+                        "top_p": msg.get("top_p", 1.0),
+                        "seed": msg.get("seed", 0),
+                        "spec_proposed": msg.get("spec_proposed", 0),
+                        "spec_accepted": msg.get("spec_accepted", 0),
                     }
                 if ttft is None:  # first streamed token, client-observed
                     ttft = time.perf_counter() - t0
@@ -276,7 +314,21 @@ def main(argv=None) -> int:
                         "tail latency under drain-free reload (artifact "
                         "mode only)")
     p.add_argument("--slots", type=int, default=8, help="engine slots (artifact mode)")
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="per-request sampling temperature (0 = greedy); "
+                        "sent on the wire per request and echoed on the "
+                        "terminal record")
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling mass per request (1.0 = full "
+                        "distribution)")
+    p.add_argument("--spec-k", type=int, default=0, metavar="K",
+                   help="artifact mode: serve speculatively with the "
+                        "draft/ subartifact proposing K tokens per round "
+                        "(serve.export.export_draft installs one)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="fixture seed: arrival pattern, prompt ids, trace "
+                        "ids, AND per-request sampling seeds all derive "
+                        "from it — same seed, same token streams")
     p.add_argument("--obs-snapshot", default=None, metavar="DIR",
                    help="write the consensusml_loadgen_* metrics snapshot "
                         "to DIR (obs-loadgen-<seed>.json, cluster snapshot "
@@ -294,6 +346,7 @@ def main(argv=None) -> int:
         engine = load_engine(
             args.artifact,
             ServeConfig(num_slots=args.slots, max_new_tokens=args.max_new),
+            spec_k=args.spec_k,
         )
         engine.warmup()
         vocab = engine._dm.vocab_size
@@ -323,6 +376,8 @@ def main(argv=None) -> int:
         len_dist=args.len_dist,
         swap_every=args.swap_every,
         swap_fn=swap_fn,
+        temperature=args.temperature,
+        top_p=args.top_p,
     )
     if engine is not None:
         report["engine"] = engine.stats()
