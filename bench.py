@@ -1391,6 +1391,66 @@ def _request_tracing_bench() -> dict:
     }
 
 
+def _analysis_bench() -> dict:
+    """Concurrency-correctness plane cost (docs/static_analysis.md):
+    per-pass wall time of the cml-check AST passes — absolute budgets
+    gated by tools/bench_diff.py (<2 s each) — plus a lockdep sanitizer
+    fuzz smoke (<30 s budget) proving the runtime wrappers stay cheap
+    enough to ride tier-1."""
+    import importlib.util
+    import threading
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "cml_check", os.path.join(root, "tools", "cml_check.py")
+    )
+    cml = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cml)
+    from consensusml_tpu.analysis import load_baseline, split_suppressed
+
+    passes = ["host-sync", "locks", "threads", "lockorder", "docs-drift"]
+    findings, timings = cml.run_passes(passes, cml.AST_PASS_PATHS)
+    baseline = load_baseline(cml.DEFAULT_BASELINE)
+    active, _suppressed, _stale = split_suppressed(findings, baseline)
+
+    # lockdep smoke: instrumented locks + fuzz harness over a small
+    # contended workload — the wall time bounds what the tier-1 e2e
+    # (tests/test_lockdep.py) pays for the sanitizer itself
+    from consensusml_tpu.analysis.lockdep import (
+        LockOrderSanitizer,
+        fuzz_schedule,
+    )
+
+    t0 = time.perf_counter()
+    with LockOrderSanitizer(fuzz=0.05, seed=0) as san:
+        class _Shared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+        shared = _Shared()
+
+        def worker():
+            for _ in range(300):
+                shared.bump()
+
+        fuzz_schedule([worker] * 4, seed=1, repeat=3)
+    smoke_s = time.perf_counter() - t0
+    assert shared.n == 4 * 300 * 3 and san.check() == []
+    return {
+        "pass_seconds": {
+            k.replace("-", "_"): round(v, 3) for k, v in timings.items()
+        },
+        "active_findings": len(active),
+        "lockdep_smoke_seconds": round(smoke_s, 3),
+        "lockdep_smoke_acquisitions": san.acquisitions,
+    }
+
+
 def _attribution_bench() -> dict:
     """Cost-attribution plane: what the compiled cost ledger KNOWS and
     what it COSTS (docs/observability.md "Cost attribution").
@@ -1997,6 +2057,9 @@ def main() -> None:
     if "--_attribution" in sys.argv:
         print("INNER_RESULT " + json.dumps(_attribution_bench()), flush=True)
         return
+    if "--_analysis" in sys.argv:
+        print("INNER_RESULT " + json.dumps(_analysis_bench()), flush=True)
+        return
     if "--_elastic" in sys.argv:
         print("INNER_RESULT " + json.dumps(_elastic_bench()), flush=True)
         return
@@ -2235,6 +2298,9 @@ def main() -> None:
     # family, three-way HBM reconciliation, and the <1%-of-a-round
     # run-time budget (docs/observability.md "Cost attribution")
     sections.append(("attribution", "--_attribution", 420, cpu_env))
+    # concurrency-correctness plane: cml-check AST-pass wall times
+    # (absolute <2 s budgets) + the lockdep sanitizer fuzz smoke
+    sections.append(("analysis", "--_analysis", 180, cpu_env))
     # elastic swarm: churn-vs-flat loss continuity, gossip-bootstrap
     # (join) cost in rounds, worst bootstrap epsilon — simulated backend,
     # CPU-capable (docs/elasticity.md)

@@ -1,6 +1,6 @@
 """cml-check: JAX-aware static analysis for the gossip training stack.
 
-Five passes (CLI: ``tools/cml_check.py --all``; docs:
+Seven passes (CLI: ``tools/cml_check.py --all``; docs:
 ``docs/static_analysis.md``):
 
 - :mod:`~consensusml_tpu.analysis.host_sync` — AST lint for host/device
@@ -9,14 +9,26 @@ Five passes (CLI: ``tools/cml_check.py --all``; docs:
 - :mod:`~consensusml_tpu.analysis.schedule` — statically materializes
   each topology's per-rank ppermute schedules from the engine's own
   bucket plans and proves bijectivity, cross-rank agreement and
-  endpoint matching — the static deadlock check.
+  endpoint matching — the static deadlock check for the COLLECTIVE wire.
 - :mod:`~consensusml_tpu.analysis.jaxpr_contracts` — traces each
   config's train step on CPU and asserts: no host callbacks, no f64
   promotion, collective counts match the verified schedule, and two
   consecutive rounds share one compilation.
 - :mod:`~consensusml_tpu.analysis.locks` — lock-discipline race lint
   over :func:`guarded_by`-annotated classes (the threaded host side:
-  prefetcher, native ring, metrics registry, watchdog).
+  prefetcher, native ring, metrics registry, watchdog, hot-swap
+  watcher, serve front-end): unguarded access, bare acquire/release,
+  and guarded-reference escape analysis.
+- :mod:`~consensusml_tpu.analysis.threads` — thread-and-handler
+  inventory: every ``threading.Thread``/``signal.signal``/excepthook
+  site cross-checked against ``docs/threads.md``, plus thread-spawning
+  classes whose lock contracts are undeclared.
+- :mod:`~consensusml_tpu.analysis.lockorder` — static lock-ordering
+  graph (nested ``with`` scopes composed through the call graph and
+  typed attributes): cycles and plain-Lock self-re-entry are
+  potential-deadlock findings — the static deadlock check for the
+  THREADED host side, and the reference model for the opt-in runtime
+  sanitizer :mod:`~consensusml_tpu.analysis.lockdep`.
 - :mod:`~consensusml_tpu.analysis.docs_drift` — metric-schema drift:
   every ``consensusml_*`` family emitted in code must appear in
   ``docs/observability.md``, and doc entries no code emits are stale.

@@ -148,19 +148,21 @@ def to_json(
     stale: list[str],
     *,
     passes_run: list[str],
+    timings: dict[str, float] | None = None,
 ) -> str:
-    return json.dumps(
-        {
-            "ok": not active,
-            "passes": passes_run,
-            "findings": [f.to_dict() for f in active],
-            "suppressed": [f.to_dict() for f in suppressed],
-            "stale_baseline": stale,
-            "counts": {
-                "active": len(active),
-                "suppressed": len(suppressed),
-                "stale": len(stale),
-            },
+    doc = {
+        "ok": not active,
+        "passes": passes_run,
+        "findings": [f.to_dict() for f in active],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "stale_baseline": stale,
+        "counts": {
+            "active": len(active),
+            "suppressed": len(suppressed),
+            "stale": len(stale),
         },
-        indent=2,
-    )
+    }
+    if timings is not None:
+        # per-pass wall seconds (bench_diff gates the AST-pass budgets)
+        doc["pass_seconds"] = {k: round(v, 4) for k, v in timings.items()}
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,424 @@
+"""Runtime lock-order sanitizer: observe what the static graph predicts.
+
+:mod:`~consensusml_tpu.analysis.lockorder` PROVES ordering from the
+source; this module WATCHES it at runtime. Opt-in (tests only — the
+wrappers put a Python frame on every acquire, which production code
+must not pay): while a :class:`LockOrderSanitizer` is installed, every
+lock constructed through ``threading.Lock()`` / ``threading.RLock()``
+is wrapped, and each acquisition records
+
+- the per-thread acquisition stack (which locks were already held),
+- one directed edge ``held -> acquired`` per held lock,
+- a resolved NAME for package locks: the wrapper is found by identity
+  in the acquiring frame's ``self.__dict__``, yielding the same
+  ``ClassName._attr`` node ids the static model uses.
+
+:meth:`LockOrderSanitizer.check` then asserts two things:
+
+- the observed graph is **acyclic** — a cycle means some interleaving
+  of the exercised paths deadlocks (the runtime twin of the static
+  ``lock-cycle`` finding, catching orders composed through code the
+  AST passes cannot resolve: dynamic dispatch, callbacks, C code);
+- the observed graph is a **subgraph of the static model** for edges
+  between package locks — an observed edge static analysis never
+  predicted means the model or the code drifted, and the lint's proof
+  no longer covers reality.
+
+The **schedule-fuzz harness** (:func:`fuzz_schedule`) drives worker
+callables concurrently under randomized ``sys.setswitchinterval``
+values with a barrier-aligned start, and the sanitizer can inject
+seeded sub-millisecond sleeps before acquisitions (``fuzz=``) to widen
+race windows — together they make one test run explore many
+interleavings deterministically-seeded. Tier-1 uses this to drive the
+paged engine's submit/drain/hot-swap/scrape/preempt paths concurrently
+(``tests/test_lockdep.py``).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+__all__ = ["LockOrderSanitizer", "fuzz_schedule"]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def _creation_site() -> str:
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename.endswith(
+        ("threading.py", "lockdep.py")
+    ):
+        f = f.f_back
+    if f is None:  # pragma: no cover - interpreter internals
+        return "anon"
+    return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+
+
+class _SanitizedLock:
+    """Duck-typed stand-in for a ``threading.Lock``/``RLock``: the
+    public protocol plus the private ``_is_owned``/``_release_save``/
+    ``_acquire_restore`` trio ``threading.Condition`` binds when
+    present — so wrapped locks work inside ``queue.Queue``,
+    ``threading.Event`` and ``Condition(RLock())`` alike, with the
+    sanitizer's held stack kept honest across ``Condition.wait``'s
+    full release/re-acquire."""
+
+    def __init__(self, san: "LockOrderSanitizer", inner: Any, kind: str):
+        self._ld_san = san
+        self._ld_inner = inner
+        self._ld_kind = kind  # "Lock" | "RLock"
+        self._ld_site = _creation_site()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._ld_san._pre_acquire(self)
+        ok = self._ld_inner.acquire(blocking, timeout)
+        if ok:
+            self._ld_san._on_acquired(self)
+        return ok
+
+    def release(self):
+        self._ld_inner.release()
+        self._ld_san._on_released(self)
+
+    def locked(self):
+        try:
+            return self._ld_inner.locked()
+        except AttributeError:  # RLock pre-3.12 has no locked()
+            if self._ld_inner.acquire(False):
+                self._ld_inner.release()
+                return False
+            return True
+
+    # -- threading.Condition private protocol ------------------------------
+    # Condition binds these when present; without them its acquire(False)
+    # fallback _is_owned() SUCCEEDS re-entrantly on a held wrapped RLock
+    # and wait() dies with "cannot wait on un-acquired lock". Implemented
+    # for both inner kinds, with the sanitizer's held stack kept honest
+    # across the full-release/re-acquire that wait() performs.
+
+    def _is_owned(self):
+        inner = self._ld_inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):  # plain Lock: stdlib fallback semantics
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        held = self._ld_san._held()
+        count = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                del held[i]
+                count += 1
+        inner = self._ld_inner
+        if hasattr(inner, "_release_save"):
+            state = inner._release_save()
+        else:
+            inner.release()
+            state = None
+        return (state, max(count, 1))
+
+    def _acquire_restore(self, saved):
+        state, count = saved
+        inner = self._ld_inner
+        if state is not None and hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        for _ in range(count):  # re-acquisition after wait(): real edges
+            self._ld_san._on_acquired(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<sanitized {self._ld_kind} @{self._ld_site}>"
+
+
+class LockOrderSanitizer:
+    """Records lock-acquisition order while installed; see module doc.
+
+    Use as a context manager around the code that CONSTRUCTS the
+    objects under test (wrapping happens at lock construction):
+
+        with LockOrderSanitizer(fuzz=0.05, seed=3) as san:
+            engine = Engine(...)
+            ... drive it from many threads ...
+        san.assert_clean(static=lockorder.static_model(REPO))
+    """
+
+    def __init__(self, fuzz: float = 0.0, seed: int = 0):
+        self.fuzz = float(fuzz)
+        self._rng = random.Random(seed)
+        self._rng_lock = _REAL_LOCK()
+        self._state = _REAL_LOCK()  # guards the maps below
+        # (holder name, acquired name) -> witness "thread / site"
+        self.edges: dict[tuple[str, str], str] = {}
+        self.reentries: dict[str, int] = {}
+        # explicit-name override hook (tests plant entries); resolved
+        # names are cached on each wrapper itself, never keyed by id
+        self._names: dict[int, tuple[str, bool]] = {}
+        self._tls = threading.local()
+        self._installed = False
+        self.acquisitions = 0
+
+    # -- installation ------------------------------------------------------
+    def install(self) -> "LockOrderSanitizer":
+        if self._installed:
+            return self
+        san = self
+
+        def make_lock():
+            return _SanitizedLock(san, _REAL_LOCK(), "Lock")
+
+        def make_rlock():
+            return _SanitizedLock(san, _REAL_RLOCK(), "RLock")
+
+        threading.Lock = make_lock  # type: ignore[assignment]
+        threading.RLock = make_rlock  # type: ignore[assignment]
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+            threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+            self._installed = False
+
+    def __enter__(self) -> "LockOrderSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- acquisition hooks -------------------------------------------------
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _pre_acquire(self, wrapper: _SanitizedLock) -> None:
+        if self.fuzz <= 0.0:
+            return
+        with self._rng_lock:
+            hit = self._rng.random() < self.fuzz
+            dt = self._rng.random() * 1e-4
+        if hit:
+            time.sleep(dt)  # widen the race window, seeded
+
+    def _on_acquired(self, wrapper: _SanitizedLock) -> None:
+        held = self._held()
+        name, is_pkg = self._name_of(wrapper)
+        with self._state:  # concurrent read-modify-write, not GIL-atomic
+            self.acquisitions += 1
+        for w, _n in held:
+            if w is wrapper:  # RLock re-entry: exempt self-loop
+                with self._state:
+                    self.reentries[name] = self.reentries.get(name, 0) + 1
+                held.append((wrapper, name))
+                return
+        if held:
+            t = threading.current_thread().name
+            with self._state:
+                for _w, h in held:
+                    if h != name:
+                        self.edges.setdefault(
+                            (h, name), f"thread {t}: {h} -> {name}"
+                        )
+        held.append((wrapper, name))
+
+    def _on_released(self, wrapper: _SanitizedLock) -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        # releases may be non-LIFO (Condition.wait drops the mutex from
+        # the middle of the stack): remove the NEWEST entry for this lock
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is wrapper:
+                del held[i]
+                return
+
+    def _name_of(self, wrapper: _SanitizedLock) -> tuple[str, bool]:
+        got = self._names.get(id(wrapper))  # test/override hook
+        if got is not None:
+            return got
+        # cache ON the wrapper, not in an id-keyed map: a GC'd wrapper's
+        # id can be reused by a new lock, which would inherit the dead
+        # lock's name and fabricate edges
+        cached = getattr(wrapper, "_ld_name", None)
+        if cached is not None:
+            return cached
+        name, is_pkg = self._resolve_name(wrapper)
+        wrapper._ld_name = (name, is_pkg)
+        return name, is_pkg
+
+    def _resolve_name(self, wrapper: _SanitizedLock) -> tuple[str, bool]:
+        """``ClassName._attr`` via identity search in the acquiring
+        frames' ``self`` objects — package classes preferred, so the
+        names line up with the static model's nodes."""
+        try:
+            f = sys._getframe(3)
+        except ValueError:  # pragma: no cover
+            f = None
+        fallback: tuple[str, bool] | None = None
+        depth = 0
+        while f is not None and depth < 20:
+            locs = f.f_locals
+            candidates = []
+            if "self" in locs:
+                candidates.append(locs["self"])
+            candidates.extend(
+                v for k, v in locs.items() if k != "self"
+            )
+            for obj in candidates:
+                if obj is None or isinstance(obj, _SanitizedLock):
+                    continue
+                d = getattr(obj, "__dict__", None)
+                if not d:
+                    continue
+                for attr, val in list(d.items()):
+                    if val is wrapper:
+                        name = f"{type(obj).__name__}.{attr}"
+                        pkg = type(obj).__module__.startswith(
+                            "consensusml_tpu"
+                        )
+                        if pkg:
+                            return name, True
+                        if fallback is None:
+                            fallback = (name, False)
+                        break
+            f = f.f_back
+            depth += 1
+        if fallback is not None:
+            return fallback
+        return f"anon@{wrapper._ld_site}", False
+
+    # -- verification ------------------------------------------------------
+    def observed_edges(self) -> dict[tuple[str, str], str]:
+        with self._state:
+            return dict(self.edges)
+
+    def check(self, static=None) -> list[str]:
+        """Violations: observed-order cycles, plus observed edges between
+        package locks the static model (a
+        :class:`~consensusml_tpu.analysis.lockorder.LockModel`) does not
+        contain."""
+        edges = self.observed_edges()
+        problems: list[str] = []
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # cycle detection: iterative DFS with colors
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {v: WHITE for v in graph}
+        for root in sorted(graph):
+            if color[root] != WHITE:
+                continue
+            stack = [(root, iter(sorted(graph[root])))]
+            color[root] = GREY
+            path = [root]
+            while stack:
+                node, it = stack[-1]
+                for nxt in it:
+                    if color[nxt] == GREY:
+                        cyc = path[path.index(nxt):] + [nxt]
+                        problems.append(
+                            "observed lock-order cycle: "
+                            + " -> ".join(cyc)
+                            + " (witness: "
+                            + edges.get((cyc[0], cyc[1]), "?")
+                            + ")"
+                        )
+                    elif color[nxt] == WHITE:
+                        color[nxt] = GREY
+                        stack.append((nxt, iter(sorted(graph[nxt]))))
+                        path.append(nxt)
+                        break
+                else:
+                    color[node] = BLACK
+                    stack.pop()
+                    path.pop()
+        if static is not None:
+            known = set(static.kinds)
+            for (a, b), wit in sorted(edges.items()):
+                if a in known and b in known and not static.has_edge(a, b):
+                    problems.append(
+                        f"observed edge {a} -> {b} is NOT in the static "
+                        f"lock model ({wit}) — the lockorder pass can no "
+                        "longer see this nesting; make the call path "
+                        "statically resolvable or re-examine the code"
+                    )
+        return problems
+
+    def assert_clean(self, static=None) -> None:
+        problems = self.check(static)
+        if problems:
+            raise AssertionError(
+                "lockdep: "
+                + "; ".join(problems)
+                + f" [{self.acquisitions} acquisitions observed]"
+            )
+
+
+def fuzz_schedule(
+    workers: Iterable[Callable[[], Any]],
+    *,
+    seed: int = 0,
+    repeat: int = 1,
+    switch_intervals: tuple = (1e-6, 1e-5, 1e-4, 5e-3),
+    timeout_s: float = 60.0,
+) -> None:
+    """Run ``workers`` concurrently ``repeat`` times under randomized
+    thread-switch intervals, each round barrier-aligned so every worker
+    starts inside the same scheduling window. Worker exceptions re-raise
+    on the caller; the previous switch interval is always restored."""
+    workers = list(workers)
+    prev = sys.getswitchinterval()
+    rng = random.Random(seed)
+    try:
+        for _round in range(repeat):
+            sys.setswitchinterval(rng.choice(switch_intervals))
+            barrier = threading.Barrier(len(workers))
+            errors: list[BaseException] = []
+
+            def run(fn: Callable[[], Any]) -> None:
+                try:
+                    barrier.wait(timeout=timeout_s)
+                    fn()
+                except BaseException as e:  # re-raised below
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(
+                    target=run, args=(w,), name=f"lockdep-fuzz-{i}",
+                    daemon=True,
+                )
+                for i, w in enumerate(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=timeout_s)
+                if t.is_alive():
+                    raise TimeoutError(
+                        f"lockdep fuzz worker {t.name} still running after "
+                        f"{timeout_s}s — possible deadlock"
+                    )
+            if errors:
+                raise errors[0]
+    finally:
+        sys.setswitchinterval(prev)

@@ -1,0 +1,632 @@
+"""Static lock-ordering graph: ABBA deadlocks found before they hang.
+
+The ``locks`` pass proves each annotated class guards its own state; it
+says nothing about ORDER. Two threads that take the same two locks in
+opposite orders deadlock — the hot-swap watcher's ``_lock`` against a
+metric's lock, a registry export against an updater — and that failure
+is unreachable by tests (it needs a precise interleaving) but fully
+decidable from the source: every acquisition in this codebase is a
+lexical ``with self.<lock>:`` (the ``bare-acquire`` rule makes that an
+invariant, not a convention).
+
+This pass builds the package-wide **lock graph**:
+
+- **nodes** — ``ClassName._lockattr`` (plus ``<module>.<name>`` for
+  module-level locks), with the lock KIND (``Lock`` / ``RLock``) read
+  off its construction site;
+- **edges** — ``A -> B`` when code can acquire B while holding A:
+  directly (a ``with self.B:`` nested inside ``with self.A:``), through
+  the same-class/same-module call graph (holding A, calling a method
+  that acquires B — composed to a fixpoint, the same machinery shape as
+  ``host_sync``'s traced-ness propagation), and across classes through
+  attribute construction sites (``self._w = GenerationWatcher(...)`` in
+  ``__init__`` types ``self._w``, so ``self._w.take()`` under a lock
+  contributes the watcher's acquisitions) plus the metric-registry
+  factory idiom (``reg.counter(...)`` returns a ``Counter``, etc.).
+
+Findings:
+
+- ``lock-cycle`` — a cycle in the graph: some interleaving of the
+  participating code paths can deadlock. The finding's detail is the
+  canonical cycle string, so the id is stable; the message carries one
+  witness code path per edge.
+- ``self-deadlock`` — a self-edge on a NON-reentrant ``threading.Lock``:
+  the thread wedges against itself on the first execution of that path,
+  no interleaving needed. Re-entry on an ``RLock`` is modeled as an
+  exempt self-loop (e.g. ``RequestTraceRegistry._finish_locked`` re-
+  entering under the registry's signal-dump RLock).
+
+The analysis under-approximates like every AST pass here (cross-module
+calls resolve only through typed attributes and known factories;
+dynamic dispatch is invisible) — it flags what it can prove. The graph
+it builds is also the STATIC MODEL the runtime sanitizer
+(:mod:`~consensusml_tpu.analysis.lockdep`) checks observed acquisition
+orders against: an observed edge between package locks that static
+analysis never predicted means the model (or the code) needs a look.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from consensusml_tpu.analysis.findings import Finding
+from consensusml_tpu.analysis.locks import _self_attr
+
+__all__ = [
+    "LockModel",
+    "analyze_sources",
+    "analyze_paths",
+    "static_model",
+    "check_repo",
+    "PASS",
+]
+
+PASS = "lockorder"
+
+# factory METHODS whose return type we know (the metrics-registry idiom:
+# self._m = reg.counter(...) hands back a Counter with its own lock)
+_FACTORY_METHODS = {
+    "counter": "Counter",
+    "gauge": "Gauge",
+    "histogram": "Histogram",
+}
+# module-level factory FUNCTIONS with known return types
+_FACTORY_FUNCS = {
+    "get_registry": "MetricsRegistry",
+    "get_request_registry": "RequestTraceRegistry",
+    "get_tracer": "SpanTracer",
+    "get_cost_ledger": "CostLedger",
+    "GenerationWatcher": "GenerationWatcher",
+}
+
+
+def _lock_ctor_kind(value: ast.AST) -> str | None:
+    """'Lock'/'RLock' when ``value`` constructs one, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    seg = (
+        value.func.attr
+        if isinstance(value.func, ast.Attribute)
+        else getattr(value.func, "id", None)
+    )
+    return seg if seg in ("Lock", "RLock") else None
+
+
+def _value_type(value: ast.AST | None) -> str | None:
+    """Bare class name an assigned value constructs, when decidable:
+    direct constructor calls, the known registry factories, and the
+    ``x if x is not None else get_registry()`` default idiom (either
+    branch resolving wins — both branches yield the same type in every
+    in-tree use of the pattern)."""
+    if isinstance(value, ast.IfExp):
+        return _value_type(value.body) or _value_type(value.orelse)
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    seg = (
+        f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+    )
+    if seg in _FACTORY_METHODS:
+        return _FACTORY_METHODS[seg]
+    if seg in _FACTORY_FUNCS:
+        return _FACTORY_FUNCS[seg]
+    if seg and seg[:1].isupper():
+        # looks like a constructor: resolved against the package-wide
+        # class index at model-build time
+        return seg
+    return None
+
+
+class _Func:
+    """One function/method body's lock-relevant events."""
+
+    __slots__ = ("qual", "line", "events")
+
+    def __init__(self, qual: str, line: int):
+        self.qual = qual
+        self.line = line
+        # (held tokens tuple, kind, payload, line):
+        #   kind "acquire": payload = token  (("self", attr)|("mod", name))
+        #   kind "call":    payload = callref
+        #     ("self", meth) | ("attr", attr, meth) | ("mod", name)
+        self.events: list[tuple[tuple, str, tuple, int]] = []
+
+
+class _Class:
+    __slots__ = (
+        "name", "path", "line", "lock_kinds", "attr_types", "methods"
+    )
+
+    def __init__(self, name: str, path: str, line: int):
+        self.name = name
+        self.path = path
+        self.line = line
+        self.lock_kinds: dict[str, str] = {}  # lock attr -> Lock|RLock
+        self.attr_types: dict[str, str] = {}  # attr -> class bare name
+        self.methods: dict[str, _Func] = {}
+
+
+class _Module:
+    __slots__ = ("path", "lock_kinds", "functions", "classes")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.lock_kinds: dict[str, str] = {}  # module-level lock name -> kind
+        self.functions: dict[str, _Func] = {}
+        self.classes: list[_Class] = []
+
+
+def _scan_body(
+    fn: ast.AST,
+    qual: str,
+    module_locks: dict[str, str],
+    out: _Func,
+) -> None:
+    """Collect acquire/call events with the lexically-held lock set.
+    Nested functions/lambdas are skipped entirely: a closure's run-time
+    lock context is unknown (same reasoning as the locks pass)."""
+
+    def scan_expr(node: ast.AST, held: tuple):
+        if isinstance(
+            node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            return
+        if isinstance(node, ast.Call):
+            ref = _callref(node.func)
+            if ref is not None:
+                out.events.append((held, "call", ref, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            scan_expr(child, held)
+
+    def walk(stmts, held: tuple):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in st.items:
+                    scan_expr(item.context_expr, tuple(now))
+                    tok = None
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None:
+                        tok = ("self", attr)
+                    elif (
+                        isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id in module_locks
+                    ):
+                        tok = ("mod", item.context_expr.id)
+                    if tok is not None:
+                        out.events.append(
+                            (tuple(now), "acquire", tok, st.lineno)
+                        )
+                        now.append(tok)
+                walk(st.body, tuple(now))
+                continue
+            # this statement's own expressions (calls live here); bodies
+            # of compound statements recurse below with the same held set
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    scan_expr(child, held)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if isinstance(sub, list):
+                    walk(sub, held)
+            for h in getattr(st, "handlers", []) or []:
+                walk(h.body, held)
+
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    walk(body, ())
+
+
+def _callref(func: ast.AST):
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ("self", func.attr)
+            return ("mod", f"{base.id}.{func.attr}")  # unresolved dotted
+        attr = _self_attr(base)
+        if attr is not None:
+            return ("attr", attr, func.attr)
+        return None
+    if isinstance(func, ast.Name):
+        return ("mod", func.id)
+    return None
+
+
+def _scan_module(src: str, rel: str) -> _Module | None:
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError:
+        return None
+    mod = _Module(rel)
+    # module-level locks first (withs in functions reference them)
+    for st in tree.body:
+        if isinstance(st, ast.Assign):
+            kind = _lock_ctor_kind(st.value)
+            if kind:
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        mod.lock_kinds[t.id] = kind
+
+    def scan_class(cls: ast.ClassDef):
+        ci = _Class(cls.name, rel, cls.lineno)
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                kind = _lock_ctor_kind(node.value)
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if kind:
+                        ci.lock_kinds[attr] = kind
+                    else:
+                        tname = _value_type(node.value)
+                        if tname is not None:
+                            ci.attr_types[attr] = tname
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = _Func(f"{cls.name}.{item.name}", item.lineno)
+                _scan_body(item, f.qual, mod.lock_kinds, f)
+                ci.methods[item.name] = f
+        mod.classes.append(ci)
+
+    for st in tree.body:
+        if isinstance(st, ast.ClassDef):
+            scan_class(st)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            f = _Func(st.name, st.lineno)
+            _scan_body(st, st.name, mod.lock_kinds, f)
+            mod.functions[st.name] = f
+    return mod
+
+
+class LockModel:
+    """The package lock graph + the finding computation over it."""
+
+    def __init__(self):
+        self.kinds: dict[str, str] = {}  # node -> Lock|RLock|"?"
+        # (a, b) -> list of (path, line, witness description)
+        self.edges: dict[tuple[str, str], list[tuple[str, int, str]]] = {}
+        # exempt RLock re-entries, kept for reporting/debug
+        self.reentries: dict[str, list[tuple[str, int, str]]] = {}
+
+    def add_edge(self, a: str, b: str, path: str, line: int, why: str):
+        self.edges.setdefault((a, b), []).append((path, line, why))
+
+    def edge_set(self) -> set[tuple[str, str]]:
+        return set(self.edges)
+
+    def has_edge(self, a: str, b: str) -> bool:
+        return (a, b) in self.edges
+
+    # -- findings ---------------------------------------------------------
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+        for (a, b), wit in sorted(self.edges.items()):
+            if a == b and self.kinds.get(a) == "Lock":
+                path, line, why = wit[0]
+                out.append(
+                    Finding(
+                        PASS, "self-deadlock", path, why.split(" ")[0], a,
+                        f"non-reentrant lock {a} is re-acquired while "
+                        f"already held ({why}) — this thread deadlocks "
+                        "against itself; use an RLock or restructure",
+                        line,
+                    )
+                )
+        for cyc in self._cycles():
+            wit = self.edges[(cyc[0], cyc[1])][0]
+            detail = "->".join(cyc)
+            paths = "; ".join(
+                f"{a}->{b} via {self.edges[(a, b)][0][2]} "
+                f"({self.edges[(a, b)][0][0]}:{self.edges[(a, b)][0][1]})"
+                for a, b in zip(cyc, cyc[1:])
+            )
+            out.append(
+                Finding(
+                    PASS, "lock-cycle", wit[0], "<graph>", detail,
+                    f"lock-order cycle {detail}: two threads taking "
+                    "these locks in opposite orders deadlock. Witness "
+                    f"paths: {paths}. Fix the ordering or split the "
+                    "critical sections",
+                    wit[1],
+                )
+            )
+        return out
+
+    def _cycles(self) -> list[list[str]]:
+        """Each multi-node SCC reduced to one canonical witness cycle
+        (stable detail strings; self-loops handled separately)."""
+        graph: dict[str, set[str]] = {}
+        for a, b in self.edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            # iterative Tarjan (deep graphs must not blow recursion)
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+
+        cycles: list[list[str]] = []
+        for scc in sccs:
+            # one witness cycle: BFS from the lexicographically-smallest
+            # node back to itself WITHIN the SCC (a greedy min-successor
+            # walk can dead-end on branchy SCCs; BFS cannot — strong
+            # connectivity guarantees a path back)
+            start = min(scc)
+            scc_set = set(scc)
+            parent: dict[str, str] = {}
+            seen = {start}
+            frontier = [start]
+            end = None
+            while frontier and end is None:
+                nxt_frontier: list[str] = []
+                for node in frontier:
+                    for w in sorted(graph[node]):
+                        if w == start:
+                            end = node
+                            break
+                        if w in scc_set and w not in seen:
+                            seen.add(w)
+                            parent[w] = node
+                            nxt_frontier.append(w)
+                    if end is not None:
+                        break
+                frontier = nxt_frontier
+            if end is None:  # pragma: no cover - SCC guarantees a cycle
+                continue
+            back = [end]
+            while back[-1] != start:
+                back.append(parent[back[-1]])
+            cycles.append(list(reversed(back)) + [start])
+        return cycles
+
+
+def _build_model(modules: list[_Module]) -> LockModel:
+    model = LockModel()
+    # bare-name class index for typed-attribute resolution. A name
+    # defined in TWO modules is ambiguous — drop it entirely rather
+    # than resolve calls against whichever definition was scanned
+    # first (a wrong-class resolution could both fabricate and MISS
+    # edges; conservative under-approximation is the pass's contract).
+    class_index: dict[str, _Class] = {}
+    ambiguous: set[str] = set()
+    for m in modules:
+        for ci in m.classes:
+            if ci.name in class_index and class_index[ci.name].path != ci.path:
+                ambiguous.add(ci.name)
+            class_index.setdefault(ci.name, ci)
+        base = os.path.splitext(os.path.basename(m.path))[0]
+        for name, kind in m.lock_kinds.items():
+            model.kinds[f"{base}.{name}"] = kind
+    for name in ambiguous:
+        class_index.pop(name, None)
+
+    def node_of(tok: tuple, ci: _Class | None, mod: _Module) -> str | None:
+        if tok[0] == "self":
+            if ci is None:
+                return None
+            name = f"{ci.name}.{tok[1]}"
+            model.kinds.setdefault(name, ci.lock_kinds.get(tok[1], "?"))
+            return name
+        base = os.path.splitext(os.path.basename(mod.path))[0]
+        return f"{base}.{tok[1]}"
+
+    # -- fixpoint: may-acquire set per (class-or-module, func) ------------
+    # key: (id(ci) or module path, method name)
+    may: dict[tuple, set[str]] = {}
+
+    def key_of(ci, mod, name):
+        # (path, class) so same-named classes in different modules keep
+        # separate may-acquire sets (node names still collide by class
+        # name, but ambiguous names are dropped from resolution above)
+        return ((ci.path, ci.name) if ci is not None else mod.path, name)
+
+    def resolve_call(ref, ci: _Class | None, mod: _Module):
+        """-> (callee _Func, callee ci, callee mod) or None."""
+        if ref[0] == "self" and ci is not None:
+            f = ci.methods.get(ref[1])
+            if f is not None:
+                return f, ci, mod
+            return None
+        if ref[0] == "attr" and ci is not None:
+            tname = ci.attr_types.get(ref[1])
+            if tname is None:
+                return None
+            target = class_index.get(tname)
+            if target is None:
+                return None
+            f = target.methods.get(ref[2])
+            if f is None:
+                return None
+            tmod = next(
+                (mm for mm in modules if mm.path == target.path), mod
+            )
+            return f, target, tmod
+        if ref[0] == "mod":
+            f = mod.functions.get(ref[1])
+            if f is not None:
+                return f, None, mod
+        return None
+
+    all_funcs: list[tuple[_Func, _Class | None, _Module]] = []
+    for m in modules:
+        for f in m.functions.values():
+            all_funcs.append((f, None, m))
+        for ci in m.classes:
+            for f in ci.methods.values():
+                all_funcs.append((f, ci, m))
+
+    for f, ci, m in all_funcs:
+        k = key_of(ci, m, f.qual.split(".")[-1])
+        may[k] = set()
+        for _held, kind, payload, _line in f.events:
+            if kind == "acquire":
+                n = node_of(payload, ci, m)
+                if n is not None:
+                    may[k].add(n)
+
+    changed = True
+    while changed:
+        changed = False
+        for f, ci, m in all_funcs:
+            k = key_of(ci, m, f.qual.split(".")[-1])
+            for _held, kind, payload, _line in f.events:
+                if kind != "call":
+                    continue
+                r = resolve_call(payload, ci, m)
+                if r is None:
+                    continue
+                cf, cci, cm = r
+                ck = key_of(cci, cm, cf.qual.split(".")[-1])
+                extra = may.get(ck, set()) - may[k]
+                if extra:
+                    may[k] |= extra
+                    changed = True
+
+    # -- edges ------------------------------------------------------------
+    for f, ci, m in all_funcs:
+        for held, kind, payload, line in f.events:
+            held_nodes = [
+                n for n in (node_of(t, ci, m) for t in held) if n is not None
+            ]
+            if not held_nodes:
+                continue
+            if kind == "acquire":
+                n = node_of(payload, ci, m)
+                if n is None:
+                    continue
+                for h in held_nodes:
+                    if h == n and model.kinds.get(n) == "RLock":
+                        model.reentries.setdefault(n, []).append(
+                            (m.path, line, f.qual)
+                        )
+                        continue
+                    model.add_edge(
+                        h, n, m.path, line,
+                        f"{f.qual} holds {h} and acquires {n}",
+                    )
+            else:
+                r = resolve_call(payload, ci, m)
+                if r is None:
+                    continue
+                cf, cci, cm = r
+                ck = key_of(cci, cm, cf.qual.split(".")[-1])
+                for n in sorted(may.get(ck, ())):
+                    for h in held_nodes:
+                        if h == n and model.kinds.get(n) == "RLock":
+                            model.reentries.setdefault(n, []).append(
+                                (m.path, line, f"{f.qual} -> {cf.qual}")
+                            )
+                            continue
+                        model.add_edge(
+                            h, n, m.path, line,
+                            f"{f.qual} holds {h}, calls {cf.qual} "
+                            f"which acquires {n}",
+                        )
+    return model
+
+
+def analyze_sources(sources: Iterable[tuple[str, str]]) -> LockModel:
+    """Build a model from ``(repo-relative path, source)`` pairs —
+    the test-fixture entry point."""
+    modules = []
+    for rel, src in sources:
+        m = _scan_module(src, rel)
+        if m is not None:
+            modules.append(m)
+    return _build_model(modules)
+
+
+def analyze_paths(paths: list[str], repo_root: str) -> LockModel:
+    sources: list[tuple[str, str]] = []
+    for p in paths:
+        files = []
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                ]
+                files.extend(
+                    os.path.join(dirpath, fn)
+                    for fn in sorted(filenames)
+                    if fn.endswith(".py")
+                )
+        for path in files:
+            rel = os.path.relpath(os.path.abspath(path), repo_root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    sources.append((rel, f.read()))
+            except OSError:
+                continue
+    return analyze_sources(sources)
+
+
+def static_model(repo_root: str) -> LockModel:
+    """The package-wide graph — also the reference model
+    :mod:`~consensusml_tpu.analysis.lockdep` validates runtime
+    acquisition orders against."""
+    pkg = os.path.join(repo_root, "consensusml_tpu")
+    return analyze_paths([pkg], repo_root)
+
+
+def check_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    return analyze_paths(paths, repo_root).findings()
+
+
+def check_repo(repo_root: str) -> list[Finding]:
+    """CLI entry (tools/cml_check.py --lockorder)."""
+    return static_model(repo_root).findings()

@@ -23,8 +23,25 @@ Rules:
   with everything.)
 - A ``with self.<lock>:`` anywhere up the lexical statement chain
   satisfies the contract; multi-item ``with`` statements count each
-  item. ``self.<lock>.acquire()`` does NOT count — the pass cannot see
-  the matching release, and the codebase convention is ``with``.
+  item.
+- ``bare-acquire`` — ``self.<lock>.acquire()`` / ``.release()`` on any
+  attribute the class assigns a ``threading.Lock``/``RLock`` to (or
+  names as a ``@guarded_by`` lock). A bare pair never satisfies the
+  guard (the pass cannot pair the release), leaks the lock on an
+  exception between the calls, and hides the critical section from the
+  lock-order graph (:mod:`~consensusml_tpu.analysis.lockorder`) — use
+  ``with``. Applies to every class, annotated or not.
+- ``guarded-escape`` — ``return``/``yield`` of a guarded MUTABLE
+  attribute (list/dict/set/deque/... per its ``__init__`` assignment)
+  as a bare reference while holding the lock: the caller now mutates or
+  iterates the shared object outside any lock. Return a copy
+  (``list(self._x)``) instead.
+- ``guarded-alias-escape`` — the two-step form of the same leak: a
+  local aliased to a guarded mutable under the lock
+  (``x = self._items``) and later returned/yielded. The
+  ownership-TRANSFER pattern (``x, self._items = self._items, None`` —
+  the shared slot is re-bound in the same ``with`` block) is exempt:
+  after the transfer the object is no longer shared.
 - Functions nested inside a method are analyzed with an EMPTY lock set:
   a closure may escape the lock scope it was created in (handed to a
   thread/callback), so holding the lock at definition time proves
@@ -85,17 +102,104 @@ def _self_attr(node: ast.AST) -> str | None:
     return None
 
 
+# constructors whose result is shared-mutable for the escape rules; a
+# frozen dataclass handed out of a lock is a snapshot, these are not
+_MUTABLE_CTORS = {
+    "list", "dict", "set", "deque", "OrderedDict", "defaultdict",
+    "bytearray", "Counter",
+}
+
+
+def _is_mutable_expr(node: ast.AST | None) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        seg = node.func.attr if isinstance(node.func, ast.Attribute) else (
+            node.func.id if isinstance(node.func, ast.Name) else None
+        )
+        return seg in _MUTABLE_CTORS
+    return False
+
+
+def _lock_attrs_of_class(
+    cls: ast.ClassDef, guard: dict[str, str]
+) -> set[str]:
+    """Attributes holding a lock: ``@guarded_by`` lock names plus every
+    ``self.<attr> = threading.Lock()/RLock()`` assignment in the class."""
+    out = set(guard.values())
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        seg = (
+            value.func.attr
+            if isinstance(value.func, ast.Attribute)
+            else getattr(value.func, "id", None)
+        )
+        if seg not in ("Lock", "RLock"):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _mutable_attrs_of_class(cls: ast.ClassDef) -> set[str]:
+    """Attributes ``__init__`` binds to a mutable container — the ones
+    whose bare reference must not leak out of the lock."""
+    out: set[str] = set()
+    for item in cls.body:
+        if (
+            isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and item.name == "__init__"
+        ):
+            for node in ast.walk(item):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    if not _is_mutable_expr(node.value):
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            out.add(attr)
+    return out
+
+
 class _MethodScan:
-    def __init__(self, guard: dict[str, str], cls_name: str, path: str):
+    def __init__(
+        self,
+        guard: dict[str, str],
+        cls_name: str,
+        path: str,
+        mutable: frozenset[str] = frozenset(),
+    ):
         self.guard = guard
         self.cls_name = cls_name
         self.path = path
+        self.mutable = mutable
         self.findings: list[Finding] = []
+        # alias-escape state, reset per method: local name -> (attr, line)
+        self._aliases: dict[str, tuple[str, int]] = {}
 
     def scan(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
         if fn.name == "__init__":
             return
-        self._walk_stmts(fn.body, frozenset(), f"{self.cls_name}.{fn.name}")
+        self._aliases = {}
+        qual = f"{self.cls_name}.{fn.name}"
+        self._walk_stmts(fn.body, frozenset(), qual)
+        if self._aliases:
+            self._check_alias_returns(fn, qual)
 
     def _walk_stmts(self, stmts, held: frozenset[str], qual: str) -> None:
         for st in stmts:
@@ -114,8 +218,11 @@ class _MethodScan:
                     self._scan_expr(item.context_expr, held, qual)
                     if item.optional_vars is not None:
                         self._scan_expr(item.optional_vars, held, qual)
+                self._collect_aliases(st, frozenset(now), qual)
                 self._walk_stmts(st.body, frozenset(now), qual)
                 continue
+            if isinstance(st, ast.Return) and st.value is not None:
+                self._check_escape(st.value, held, qual)
             # compound statements: scan their own expressions with the
             # current lock set, then their bodies
             for field in ("test", "iter", "value", "exc", "cause", "msg"):
@@ -151,6 +258,134 @@ class _MethodScan:
             )
         )
 
+    # -- escape analysis ----------------------------------------------------
+
+    def _guarded_mutable(self, node: ast.AST, held: frozenset[str]):
+        """``(attr, lock)`` when ``node`` is a bare reference to a
+        guarded mutable attribute whose lock is currently held."""
+        attr = _self_attr(node)
+        if attr is None or attr not in self.mutable:
+            return None
+        lock = self.guard.get(attr)
+        if lock is None or lock not in held:
+            return None
+        return attr, lock
+
+    def _check_escape(self, value: ast.AST, held: frozenset[str], qual: str):
+        hit = self._guarded_mutable(value, held)
+        if hit is None:
+            return
+        attr, lock = hit
+        self.findings.append(
+            Finding(
+                PASS, "guarded-escape", self.path, qual, attr,
+                f"bare reference to mutable self.{attr} escapes the "
+                f"`with self.{lock}:` block via return/yield — the "
+                "caller mutates/iterates it with no lock; hand out a "
+                f"copy (e.g. list(self.{attr}))",
+                value.lineno,
+            )
+        )
+
+    def _collect_aliases(
+        self, with_node: ast.AST, held: frozenset[str], qual: str
+    ) -> None:
+        """Record ``x = self.<guarded mutable>`` bindings made under the
+        lock — unless the same ``with`` body re-binds the attribute
+        (ownership transfer)."""
+        def restricted(node):
+            # this with's own straight-line body: recurse through
+            # compound statements but NOT nested withs (their own call
+            # collects them) or nested functions (closure rule)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.With, ast.AsyncWith, ast.FunctionDef,
+                     ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                yield child
+                yield from restricted(child)
+
+        stores: set[str] = set()
+        assigns: list[tuple[str, str, int]] = []  # (local, attr, line)
+        for node in restricted(with_node):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                attr = _self_attr(node)
+                if attr is not None:
+                    stores.add(attr)
+            if isinstance(node, ast.Assign):
+                pairs: list[tuple[ast.AST, ast.AST]] = []
+                if (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], (ast.Tuple, ast.List))
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                    and len(node.targets[0].elts) == len(node.value.elts)
+                ):
+                    pairs = list(zip(node.targets[0].elts, node.value.elts))
+                else:
+                    pairs = [(t, node.value) for t in node.targets]
+                for tgt, val in pairs:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    hit = self._guarded_mutable(val, held)
+                    if hit is not None:
+                        assigns.append((tgt.id, hit[0], tgt.lineno))
+        for local, attr, line in assigns:
+            if attr not in stores:  # re-bound in-block == transfer, exempt
+                self._aliases[local] = (attr, line)
+
+    def _check_alias_returns(self, fn: ast.AST, qual: str) -> None:
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                yield child
+                yield from walk(child)
+
+        # a later re-binding breaks the alias — `x = list(x)` (the very
+        # copy the escape rule recommends) must not be flagged
+        rebinds: dict[str, set[int]] = {}
+        for node in walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                rebinds.setdefault(node.id, set()).add(node.lineno)
+        for name in list(self._aliases):
+            _attr, bind_line = self._aliases[name]
+            if rebinds.get(name, set()) - {bind_line}:
+                del self._aliases[name]
+
+        for node in walk(fn):
+            value = None
+            if isinstance(node, ast.Return):
+                value = node.value
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)):
+                value = node.value
+            if not isinstance(value, ast.Name):
+                continue
+            hit = self._aliases.get(value.id)
+            if hit is None:
+                continue
+            attr, bind_line = hit
+            lock = self.guard.get(attr, "?")
+            self.findings.append(
+                Finding(
+                    PASS, "guarded-alias-escape", self.path, qual, attr,
+                    f"local {value.id!r} aliases mutable self.{attr} "
+                    f"under `with self.{lock}:` (line {bind_line}) and "
+                    "escapes via return/yield — the shared object leaks "
+                    "out of the lock; copy it, or re-bind the attribute "
+                    "in the same block (ownership transfer)",
+                    value.lineno,
+                )
+            )
+
     def _scan_target(self, node: ast.AST, held: frozenset[str], qual: str):
         attr = _self_attr(node)
         if attr is not None:
@@ -171,6 +406,11 @@ class _MethodScan:
                 node.body, frozenset(), f"{qual}.<locals>.<lambda>"
             )
             return
+        if (
+            isinstance(node, (ast.Yield, ast.YieldFrom))
+            and node.value is not None
+        ):
+            self._check_escape(node.value, held, qual)
         attr = _self_attr(node)
         if attr is not None:
             lock = self.guard.get(attr)
@@ -179,6 +419,42 @@ class _MethodScan:
                 self._flag(attr, lock, write, node.lineno, qual)
         for child in ast.iter_child_nodes(node):
             self._scan_expr(child, held, qual)
+
+
+def _scan_bare_acquire(
+    cls: ast.ClassDef, lock_attrs: set[str], path: str
+) -> list[Finding]:
+    """``self.<lock>.acquire()``/``.release()`` anywhere in the class —
+    the ``with``-less form the guard rules cannot see through."""
+    findings: list[Finding] = []
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{cls.name}.{item.name}"
+        for node in ast.walk(item):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("acquire", "release")
+            ):
+                attr = _self_attr(fn.value)
+                if attr in lock_attrs:
+                    findings.append(
+                        Finding(
+                            PASS, "bare-acquire", path, qual, attr,
+                            f"bare self.{attr}.{fn.attr}() — the lint "
+                            "cannot pair it with its release, an "
+                            "exception between the pair leaks the lock, "
+                            "and the lock-order graph cannot see the "
+                            "critical section; use `with "
+                            f"self.{attr}:` (guard a try-acquire with a "
+                            "flag under a plain `with` instead)",
+                            node.lineno,
+                        )
+                    )
+    return findings
 
 
 def lint_source(src: str, path: str) -> list[Finding]:
@@ -196,9 +472,15 @@ def lint_source(src: str, path: str) -> list[Finding]:
         if not isinstance(node, ast.ClassDef):
             continue
         guard = _guard_map_from_class(node)
+        lock_attrs = _lock_attrs_of_class(node, guard)
+        if lock_attrs:
+            findings.extend(_scan_bare_acquire(node, lock_attrs, path))
         if not guard:
             continue
-        scan = _MethodScan(guard, node.name, path)
+        scan = _MethodScan(
+            guard, node.name, path,
+            mutable=frozenset(_mutable_attrs_of_class(node)),
+        )
         for item in node.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scan.scan(item)

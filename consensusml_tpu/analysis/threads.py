@@ -1,0 +1,337 @@
+"""Thread-and-handler inventory pass: the concurrency surface is checked in.
+
+The host side of the stack is threaded on purpose — engine loop,
+hot-swap watcher, socket front-end, metrics HTTP server, prefetcher,
+async checkpoint writer, watchdog — and hooked into process-global
+machinery (``signal.signal``, ``threading.excepthook``/``sys.excepthook``
+chains). Every one of those is a concurrency obligation: someone must
+know it exists, what state it touches, whether it is a daemon, and who
+joins it on shutdown. Nothing enforced that before this pass; a new
+thread or signal handler landed silently.
+
+``docs/threads.md`` is the checked-in inventory (same contract shape as
+``docs/observability.md`` for metric families). This pass AST-collects
+every
+
+- ``threading.Thread(...)`` construction site,
+- ``signal.signal(...)`` registration site,
+- ``threading.excepthook`` / ``sys.excepthook`` assignment site,
+
+across the package + CLI entry points and cross-checks them against the
+inventory:
+
+- ``undocumented-thread`` / ``undocumented-handler`` — a site the
+  inventory does not list (a new thread/handler must be documented to
+  land);
+- ``stale-thread-doc`` — an inventory row no code site backs any more;
+- ``daemon-mismatch`` — the site's literal ``daemon=`` disagrees with
+  the inventory's daemon column (a daemon thread silently dying at
+  interpreter exit vs a non-daemon thread blocking it is a shutdown
+  contract, not a detail);
+- ``unannotated-thread-state`` — a class that spawns a thread AND owns
+  a ``threading.Lock``/``RLock`` attribute but carries no
+  ``@guarded_by`` annotation: the lock exists, so the class KNOWS its
+  state is shared, but the contract is invisible to the lock lint
+  (:mod:`~consensusml_tpu.analysis.locks`).
+
+An inventory row's key is exactly the tail of the site's finding id —
+``path:symbol:detail`` — so the doc and the findings never drift in
+format. Baseline mechanics are shared with every other pass
+(``.cml-check-baseline``, stale entries reported).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from consensusml_tpu.analysis.findings import Finding
+from consensusml_tpu.analysis.locks import (
+    _guard_map_from_class,
+    _lock_attrs_of_class,
+)
+
+__all__ = [
+    "collect_sites",
+    "documented_sites",
+    "run",
+    "check_repo",
+    "Site",
+]
+
+PASS = "threads"
+DOC_RELPATH = os.path.join("docs", "threads.md")
+
+# a doc key is `path.py:Sym.bol:detail` in backticks; details may hold
+# dots/underscores/dashes and call parens (thread names,
+# `self._serve_conn` targets, `functools.partial(...)`-style call
+# tokens, SIGTERM, threading.excepthook)
+_KEY_RE = re.compile(r"`([\w/.\-]+\.py:[\w.<>-]+:[\w.<>()\- ]+)`")
+
+
+class Site:
+    """One collected concurrency site."""
+
+    __slots__ = ("kind", "path", "symbol", "detail", "line", "daemon")
+
+    def __init__(self, kind, path, symbol, detail, line, daemon=None):
+        self.kind = kind  # "thread" | "signal" | "excepthook"
+        self.path = path
+        self.symbol = symbol
+        self.detail = detail
+        self.line = line
+        self.daemon = daemon  # True/False when a literal, else None
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.symbol or '<module>'}:{self.detail}"
+
+
+def _last_segment(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _token(node: ast.AST) -> str:
+    """A short stable token for a target expression: ``self._run`` /
+    ``self._httpd.serve_forever`` / ``write``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_token(node.value)}.{node.attr}"
+    if isinstance(node, ast.Lambda):
+        return "<lambda>"
+    if isinstance(node, ast.Call):
+        return f"{_token(node.func)}(...)"
+    return "<expr>"
+
+
+def _collect_file(path: str, rel: str) -> tuple[list[Site], list[Finding]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return [], []
+
+    sites: list[Site] = []
+    findings: list[Finding] = []
+    stack: list[str] = []
+
+    def symbol() -> str:
+        return ".".join(stack)
+
+    def visit(node: ast.AST) -> None:
+        scoped = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        )
+        if scoped:
+            stack.append(node.name)
+        if isinstance(node, ast.ClassDef):
+            _scan_class_thread_state(node, rel, symbol(), findings)
+        if isinstance(node, ast.Call):
+            seg = _last_segment(node.func)
+            if seg == "Thread":
+                name = daemon = None
+                target = "<unnamed>"
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                        name = kw.value.value
+                    elif kw.arg == "daemon" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        daemon = bool(kw.value.value)
+                    elif kw.arg == "target":
+                        target = _token(kw.value)
+                detail = str(name) if name is not None else target
+                sites.append(
+                    Site("thread", rel, symbol(), detail, node.lineno, daemon)
+                )
+            elif (
+                seg == "signal"
+                and isinstance(node.func, ast.Attribute)
+                and _last_segment(node.func.value) == "signal"
+                and node.args
+            ):
+                sig = _last_segment(node.args[0]) or "dynamic"
+                sites.append(
+                    Site("signal", rel, symbol(), sig, node.lineno)
+                )
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "excepthook"
+                    and _last_segment(t.value) in ("threading", "sys")
+                ):
+                    sites.append(
+                        Site(
+                            "excepthook", rel, symbol(),
+                            f"{_last_segment(t.value)}.excepthook",
+                            node.lineno,
+                        )
+                    )
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if scoped:
+            stack.pop()
+
+    visit(tree)
+    return sites, findings
+
+
+def _scan_class_thread_state(
+    cls: ast.ClassDef, rel: str, qual: str, findings: list[Finding]
+) -> None:
+    """A thread-spawning class with locks but no @guarded_by: the
+    sharing is real (the lock proves it) but the contract is undeclared,
+    so the lock lint guards nothing."""
+    spawns = any(
+        isinstance(n, ast.Call) and _last_segment(n.func) == "Thread"
+        for n in ast.walk(cls)
+    )
+    if not spawns:
+        return
+    guard = _guard_map_from_class(cls)
+    if guard:
+        return  # annotated: the lock lint owns it from here
+    lock_attrs = _lock_attrs_of_class(cls, guard)
+    if not lock_attrs:
+        return  # stateless spawner (events/queues only): nothing to guard
+    findings.append(
+        Finding(
+            PASS, "unannotated-thread-state", rel, qual,
+            ",".join(sorted(lock_attrs)),
+            f"class {cls.name} spawns a thread and owns lock(s) "
+            f"{sorted(lock_attrs)} but has no @guarded_by annotation — "
+            "declare the lock contract so the locks pass can enforce it",
+            cls.lineno,
+        )
+    )
+
+
+def documented_sites(doc_path: str) -> dict[str, dict]:
+    """Inventory rows keyed by ``path:symbol:detail``. The daemon column
+    (2nd cell) is honored when it is ``yes``/``no``; anything else
+    (including ``-`` for handlers) skips the daemon check."""
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return {}
+    out: dict[str, dict] = {}
+    for line in lines:
+        if not line.lstrip().startswith("|"):
+            continue
+        m = _KEY_RE.search(line)
+        if not m:
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        daemon = None
+        if len(cells) >= 2 and cells[1].lower() in ("yes", "no"):
+            daemon = cells[1].lower() == "yes"
+        out[m.group(1)] = {"daemon": daemon}
+    return out
+
+
+def default_sources(repo_root: str) -> list[str]:
+    from consensusml_tpu.analysis import docs_drift
+
+    return docs_drift.default_sources(repo_root)
+
+
+def collect_sites(
+    py_files: Iterable[str], repo_root: str
+) -> tuple[list[Site], list[Finding]]:
+    sites: list[Site] = []
+    findings: list[Finding] = []
+    for path in sorted(py_files):
+        rel = os.path.relpath(os.path.abspath(path), repo_root)
+        s, f = _collect_file(path, rel)
+        sites.extend(s)
+        findings.extend(f)
+    return sites, findings
+
+
+def run(
+    repo_root: str,
+    py_files: Iterable[str] | None = None,
+    doc_path: str | None = None,
+    report_stale: bool | None = None,
+) -> list[Finding]:
+    """``report_stale`` defaults to True only when the FULL default
+    source set is scanned — a ``--paths``-restricted run cannot tell a
+    stale row from a row whose site it simply did not scan."""
+    files = (
+        list(py_files) if py_files is not None else default_sources(repo_root)
+    )
+    if report_stale is None:
+        report_stale = py_files is None
+    doc = (
+        doc_path if doc_path is not None
+        else os.path.join(repo_root, DOC_RELPATH)
+    )
+    doc_rel = os.path.relpath(os.path.abspath(doc), repo_root)
+    sites, findings = collect_sites(files, repo_root)
+    documented = documented_sites(doc)
+
+    seen_keys: set[str] = set()
+    for s in sites:
+        seen_keys.add(s.key)
+        row = documented.get(s.key)
+        if row is None:
+            rule = (
+                "undocumented-thread"
+                if s.kind == "thread"
+                else "undocumented-handler"
+            )
+            what = {
+                "thread": "thread spawn",
+                "signal": "signal handler registration",
+                "excepthook": "excepthook chain",
+            }[s.kind]
+            findings.append(
+                Finding(
+                    PASS, rule, s.path, s.symbol, s.detail,
+                    f"{what} ({s.detail}) is not in the {doc_rel} "
+                    "inventory — document it (daemon/join discipline + "
+                    "purpose) or remove it",
+                    s.line,
+                )
+            )
+            continue
+        if (
+            s.kind == "thread"
+            and row["daemon"] is not None
+            and s.daemon is not None
+            and s.daemon != row["daemon"]
+        ):
+            findings.append(
+                Finding(
+                    PASS, "daemon-mismatch", s.path, s.symbol, s.detail,
+                    f"thread {s.detail!r} is daemon={s.daemon} in code "
+                    f"but the {doc_rel} inventory says "
+                    f"daemon={row['daemon']} — shutdown discipline "
+                    "drifted; fix whichever side is wrong",
+                    s.line,
+                )
+            )
+    for key in sorted(set(documented) - seen_keys) if report_stale else []:
+        findings.append(
+            Finding(
+                PASS, "stale-thread-doc", doc_rel, "<doc>", key,
+                f"{doc_rel} lists {key!r} but no code site matches — "
+                "prune the row or restore the thread/handler",
+                0,
+            )
+        )
+    return findings
+
+
+def check_repo(repo_root: str) -> list[Finding]:
+    """CLI entry (tools/cml_check.py --threads)."""
+    return run(repo_root)

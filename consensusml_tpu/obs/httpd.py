@@ -48,6 +48,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from consensusml_tpu.analysis import guarded_by
 from consensusml_tpu.obs.metrics import MetricsRegistry, get_registry
 from consensusml_tpu.obs.requests import (
     RequestTraceRegistry,
@@ -78,8 +79,18 @@ def _xprof_summary_json(trace_json: str) -> dict | None:
         return None
 
 
+@guarded_by("_lock", "_profile_inflight", "_profile_seq")
 class MetricsServer:
-    """Threaded HTTP exporter over the process's observability state."""
+    """Threaded HTTP exporter over the process's observability state.
+
+    ``/profile`` single-flight state is a flag under a plain ``with``
+    lock, NOT a held-across-the-capture lock: scraper handler threads
+    race only on the few-instruction check-and-set, and the 409 loser
+    reads the winner's capture id under the same lock it was written
+    (the old bare try-``acquire``/``release`` pair additionally read
+    ``_profile_inflight`` unlocked — fixed by cml-check's
+    ``locks:bare-acquire`` rule landing, see docs/static_analysis.md).
+    """
 
     def __init__(
         self,
@@ -104,7 +115,7 @@ class MetricsServer:
             tempfile.gettempdir(), f"cml-profiles-{os.getpid()}"
         )
         self.profile_quota = max(1, int(profile_quota))
-        self._profile_lock = threading.Lock()
+        self._lock = threading.Lock()
         self._profile_seq = 0
         self._profile_inflight: str | None = None
         self._m_captures = registry.counter(
@@ -173,8 +184,9 @@ class MetricsServer:
 
         Runs ON the scraper's handler thread: the hot paths never wait
         on it, and the profiler's own overhead is confined to the
-        requested window. The non-blocking lock acquire IS the
-        single-flight guard — the loser reads the winner's capture id.
+        requested window. The locked check-and-set of
+        ``_profile_inflight`` IS the single-flight guard — the loser
+        reads the winner's capture id under the same lock.
         """
         try:
             ms = int(query.get("ms", [PROFILE_DEFAULT_MS])[0])
@@ -182,18 +194,21 @@ class MetricsServer:
             return 400, {"error": "ms must be an integer"}
         ms = min(max(ms, 10), PROFILE_MAX_MS)
 
-        if not self._profile_lock.acquire(blocking=False):
+        with self._lock:
+            inflight = self._profile_inflight
+            if inflight is None:
+                self._profile_seq += 1
+                cap_id = f"cap-{self._profile_seq:05d}-{int(time.time())}"
+                self._profile_inflight = cap_id
+        if inflight is not None:
             self._m_prof_rejected.inc()
             return 409, {
                 "error": "a profile capture is already in flight",
-                "capture_id": self._profile_inflight,
+                "capture_id": inflight,
             }
         try:
             import jax
 
-            self._profile_seq += 1
-            cap_id = f"cap-{self._profile_seq:05d}-{int(time.time())}"
-            self._profile_inflight = cap_id
             cap_dir = os.path.join(self.profile_dir, cap_id)
             os.makedirs(cap_dir, exist_ok=True)
             try:
@@ -230,8 +245,8 @@ class MetricsServer:
                 ),
             }
         finally:
-            self._profile_inflight = None
-            self._profile_lock.release()
+            with self._lock:
+                self._profile_inflight = None
 
     def _rotate_captures(self) -> None:
         """Keep the newest ``profile_quota`` capture dirs (ids sort by

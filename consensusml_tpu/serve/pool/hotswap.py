@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from consensusml_tpu.analysis import guarded_by
+
 __all__ = ["StagedSwap", "GenerationWatcher"]
 
 
@@ -52,6 +54,9 @@ class StagedSwap:
     draft_params: Any = None
 
 
+@guarded_by(
+    "_lock", "_staged", "_generation", "_rejected_gen", "_flip_rejected"
+)
 class GenerationWatcher:
     """Polls a serving-artifact dir and stages new generations.
 
@@ -60,6 +65,17 @@ class GenerationWatcher:
     window the engine flips straight to the newest. The loader runs on
     the watcher thread; a torn/corrupt artifact read (export in flight)
     is retried next poll, never propagated into the serving loop.
+
+    The watcher thread (``poll_once``) and the engine thread (``take``/
+    ``reject``) share four fields — the staged swap, the accepted-
+    generation mark and the two rejection markers — ALL moved under
+    ``_lock`` (enforced by ``@guarded_by``): the engine's flip-time
+    ``reject()`` rolls the generation mark BACK while a poll may be
+    mid-flight, so the old lock-free reads could stage against a stale
+    mark or miss a rejection marker entirely. The artifact load itself
+    (orbax restore + ``device_put`` + fence, the seconds-long part)
+    stays OUTSIDE the lock; ``take()`` is one uncontended lock per
+    decode step.
     """
 
     def __init__(
@@ -78,7 +94,7 @@ class GenerationWatcher:
         # speculative engines: restage the draft/ subartifact with every
         # parent-generation advance (the parent counter orders the pair)
         self.stage_draft = stage_draft
-        self.generation = current_generation  # newest ACCEPTED generation
+        self._generation = current_generation  # newest ACCEPTED generation
         self._loader = loader
         self._staged: StagedSwap | None = None
         self._rejected_gen: int | None = None  # last regression counted
@@ -107,6 +123,17 @@ class GenerationWatcher:
         )
         self._thread.start()
 
+    @property
+    def generation(self) -> int:
+        """Newest ACCEPTED generation (staged or already flipped)."""
+        with self._lock:
+            return self._generation
+
+    @generation.setter
+    def generation(self, value: int) -> None:
+        with self._lock:
+            self._generation = int(value)
+
     # -- watcher thread -----------------------------------------------------
 
     def _run(self) -> None:
@@ -128,12 +155,16 @@ class GenerationWatcher:
         except ValueError:
             return False  # no artifact yet / torn write in progress
         gen = int(meta.get("generation", 0))
-        if gen <= self.generation:
+        with self._lock:
+            behind = gen <= self._generation
             # count each observed regression ONCE, not once per poll — a
             # stale artifact sits on disk until replaced, and a counter
             # ramping 4/s would read as a flood of bad exports
-            if gen < self.generation and gen != self._rejected_gen:
+            regressed = gen < self._generation and gen != self._rejected_gen
+            if regressed:
                 self._rejected_gen = gen
+        if behind:
+            if regressed:
                 self._m_rejected.inc()
             return False
         import os
@@ -142,8 +173,10 @@ class GenerationWatcher:
             mtime = os.path.getmtime(os.path.join(self.path, META_NAME))
         except OSError:
             return False  # replaced between read and stat; next poll
-        if self._flip_rejected == (gen, mtime):
-            return False  # engine rejected THIS artifact; await a rewrite
+        with self._lock:
+            if self._flip_rejected == (gen, mtime):
+                # engine rejected THIS artifact; await a rewrite
+                return False
         import jax
 
         t0 = time.perf_counter()
@@ -171,17 +204,20 @@ class GenerationWatcher:
             jax.block_until_ready(draft_params)
         self._m_load.observe(time.perf_counter() - t0)
         with self._lock:
+            # re-check: the engine may have rejected THIS (gen, mtime)
+            # during the seconds-long load above — staging it anyway
+            # would re-run the doomed flip/reject cycle once per poll
+            if self._flip_rejected == (gen, mtime):
+                return False
             self._staged = StagedSwap(gen, params, meta, mtime, draft_params)
-            self.generation = gen
+            self._generation = gen
         self._m_staged.inc()
         return True
 
     # -- engine thread ------------------------------------------------------
 
     def take(self) -> StagedSwap | None:
-        if self._staged is None:  # benign race: worst case, next step
-            return None
-        with self._lock:
+        with self._lock:  # uncontended except in the staging instant
             staged, self._staged = self._staged, None
         return staged
 
@@ -200,8 +236,8 @@ class GenerationWatcher:
             return
         with self._lock:
             self._flip_rejected = (staged.generation, staged.meta_mtime)
-            if self.generation == staged.generation:
-                self.generation = staged.generation - 1
+            if self._generation == staged.generation:
+                self._generation = staged.generation - 1
 
     def stop(self) -> None:
         self._stop.set()

@@ -44,9 +44,12 @@ import socket
 import threading
 from typing import Any
 
+from consensusml_tpu.analysis import guarded_by
+
 __all__ = ["ServeServer"]
 
 
+@guarded_by("_conns_lock", "_conns")
 class ServeServer:
     """Accept loop + one thread per connection; ``port=0`` picks a free
     port (read it back from :attr:`address`).
@@ -86,6 +89,12 @@ class ServeServer:
         self._sock.settimeout(0.2)  # accept loop polls the stop flag
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
+        # mutated by the accept loop (add), every connection thread
+        # (discard on exit) and shutdown (snapshot for the join sweep):
+        # an unlocked set here could blow up shutdown's iteration with
+        # "set changed size during iteration" when an accept races the
+        # drain — the exact seam the threads/lockorder passes audit
+        self._conns_lock = threading.Lock()
         self._conns: set[threading.Thread] = set()
         self._thread = threading.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True
@@ -103,7 +112,8 @@ class ServeServer:
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
-            self._conns.add(t)
+            with self._conns_lock:
+                self._conns.add(t)
             t.start()
         self._sock.close()
 
@@ -161,7 +171,8 @@ class ServeServer:
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-stream; the engine still finishes
         finally:
-            self._conns.discard(threading.current_thread())
+            with self._conns_lock:
+                self._conns.discard(threading.current_thread())
 
     def install_sigterm(self) -> None:
         """SIGTERM (and SIGINT) => graceful drain-then-exit."""
@@ -176,7 +187,9 @@ class ServeServer:
         admitted request completes before the process exits."""
         self._stop.set()
         self.engine.shutdown(drain=drain, timeout=timeout)
-        for t in list(self._conns):  # let response streams flush
+        with self._conns_lock:
+            conns = list(self._conns)
+        for t in conns:  # let response streams flush
             t.join(timeout=2.0)
         self._thread.join(timeout=2.0)
         if self.metrics is not None:

@@ -108,6 +108,7 @@ class MemberView:
 
 
 @guarded_by("_lock", "_current", "_staged", "_pins", "_retired")
+@guarded_by("_feed_lock", "_fed_epoch")
 class MembershipController:
     """Owner of the live member view; see the module docstring for the
     propose/advance/pin protocol."""
@@ -123,6 +124,14 @@ class MembershipController:
         self._pins: dict[int, int] = {}
         self._retired: dict[int, MemberView] = {}
         self._registry = registry
+        # serializes the GAUGE side of metric feeds: the claim and the
+        # sets must be one critical section or a preempted older feed
+        # could land its gauge values after a newer one's. Dedicated
+        # lock (not _lock) so the view lock never spans foreign metric
+        # locks; Gauge/Registry locks are leaves, so no cycle is
+        # possible through this hold.
+        self._feed_lock = threading.Lock()
+        self._fed_epoch = -1  # newest epoch whose gauges were fed
         self._feed_metrics(self._current, events=())
 
     # ---- reads -----------------------------------------------------------
@@ -268,8 +277,15 @@ class MembershipController:
             self._retired = {
                 e: v for e, v in self._retired.items() if e in self._pins
             }
-            self._feed_metrics(new, events)
-            return new
+        # metrics feed OUTSIDE the view lock: gauge/counter updates take
+        # the registry's and each metric's own lock, and holding the
+        # controller lock across foreign locks is exactly the cross-class
+        # nesting the lockorder pass exists to keep out of the graph.
+        # _feed_metrics's monotonic-epoch claim keeps two racing
+        # advances from feeding the gauges in the wrong order; the view
+        # itself was installed atomically above.
+        self._feed_metrics(new, events)
+        return new
 
     @staticmethod
     def _check_slot(members: list, u: int) -> None:
@@ -280,22 +296,34 @@ class MembershipController:
 
     # ---- telemetry -------------------------------------------------------
     def _feed_metrics(self, view: MemberView, events) -> None:
-        """consensusml_swarm_* families (docs/observability.md)."""
+        """consensusml_swarm_* families (docs/observability.md).
+
+        Runs OUTSIDE the view lock (see :meth:`advance`): the gauges
+        carry a monotonic-epoch claim so two advances racing into their
+        feeds cannot leave the gauges at the older epoch; event counters
+        always count (they are per-event totals, not point-in-time).
+        """
         if self._registry is None:
             return
         reg = self._registry
-        reg.gauge(
-            "consensusml_swarm_epoch",
-            "membership epoch of the live member view",
-        ).set(view.epoch)
-        reg.gauge(
-            "consensusml_swarm_members",
-            "members currently ACTIVE in the swarm",
-        ).set(view.n_active)
-        reg.gauge(
-            "consensusml_swarm_world_size",
-            "total member slots (active + dead + straggling)",
-        ).set(view.world_size)
+        with self._feed_lock:
+            # claim + sets are ONE critical section: a feed that merely
+            # claimed first but set last would leave the gauges at the
+            # older epoch until the next advance
+            if view.epoch >= self._fed_epoch:
+                self._fed_epoch = view.epoch
+                reg.gauge(
+                    "consensusml_swarm_epoch",
+                    "membership epoch of the live member view",
+                ).set(view.epoch)
+                reg.gauge(
+                    "consensusml_swarm_members",
+                    "members currently ACTIVE in the swarm",
+                ).set(view.n_active)
+                reg.gauge(
+                    "consensusml_swarm_world_size",
+                    "total member slots (active + dead + straggling)",
+                ).set(view.world_size)
         for kind, uids in events:
             reg.counter(
                 "consensusml_swarm_events_total",

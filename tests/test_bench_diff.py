@@ -49,6 +49,41 @@ def test_default_spec_is_well_formed():
     assert "serving.spec.spec.acceptance_rate" in keys
     assert "serving.spec.spec.zero_recompiles_after_warmup" in keys
     assert "serving.spec.baseline.zero_recompiles_after_warmup" in keys
+    # the concurrency-correctness plane (ISSUE 14): per-pass wall
+    # budgets for the AST passes, the lockdep smoke budget, zero active
+    # findings
+    for p in ("host_sync", "locks", "threads", "lockorder", "docs_drift"):
+        assert f"analysis.pass_seconds.{p}" in keys
+    assert "analysis.lockdep_smoke_seconds" in keys
+    assert "analysis.active_findings" in keys
+
+
+def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):
+    """A fresh bench whose analysis section blows a pass-time budget,
+    the lockdep smoke budget, or reports an active finding fails."""
+    mod = _tool()
+    fresh = {
+        "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+        "analysis": {
+            "pass_seconds": {
+                "host_sync": 0.6, "locks": 0.4, "threads": 9.0,
+                "lockorder": 0.4, "docs_drift": 0.5,
+            },
+            "active_findings": 2,
+            "lockdep_smoke_seconds": 45.0,
+        },
+    }
+    path = tmp_path / "fresh.json"
+    path.write_text(json.dumps(fresh))
+    rc = mod.main([str(path), "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "analysis.pass_seconds.threads" in failed
+    assert "analysis.active_findings" in failed
+    assert "analysis.lockdep_smoke_seconds" in failed
+    ok = {r["key"]: r["status"] for r in doc["rows"]}
+    assert ok["analysis.pass_seconds.host_sync"] == "ok"
 
 
 def test_min_direction_enforces_floors(tmp_path, capsys):

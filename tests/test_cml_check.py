@@ -283,19 +283,386 @@ def test_guarded_by_records_contract_at_runtime():
 
 
 def test_repo_threaded_modules_are_annotated_and_clean():
-    """The four threaded host-side modules carry @guarded_by and pass
-    the lint — the satellite contract of this PR."""
+    """The threaded host-side modules carry @guarded_by and pass the
+    lint — including the ISSUE 14 additions (hot-swap watcher, serve
+    front-end, metrics HTTP server)."""
     for rel in (
         "consensusml_tpu/obs/metrics.py",
+        "consensusml_tpu/obs/httpd.py",
         "consensusml_tpu/data/prefetch.py",
         "consensusml_tpu/native/__init__.py",
         "consensusml_tpu/utils/watchdog.py",
+        "consensusml_tpu/serve/pool/hotswap.py",
+        "consensusml_tpu/serve/server.py",
     ):
         path = os.path.join(REPO, rel)
         fs = locks.lint_file(path, REPO)
         assert fs == [], f"{rel}: {[f.render() for f in fs]}"
         src = open(path).read()
         assert "guarded_by(" in src, f"{rel} lost its annotations"
+
+
+def test_bare_acquire_is_flagged():
+    """ISSUE 14 satellite: the blind spot the old module docstring
+    admitted — a bare acquire/release pair on a class's lock attr is now
+    a finding (the in-tree occurrence in obs/httpd.py was converted to a
+    with-guarded flag)."""
+    fs = _lint_locks(
+        """
+        import threading
+
+        class S:  # note: bare-acquire needs no @guarded_by annotation
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+
+            def try_bad(self):
+                if not self._lock.acquire(blocking=False):
+                    return None
+                self._lock.release()
+        """
+    )
+    assert _rules(fs) == ["bare-acquire"]
+    assert {f.symbol for f in fs} == {"S.bad", "S.try_bad"}
+    # both calls in one method share one finding id (baseline granularity)
+    assert len({f.id for f in fs if f.symbol == "S.bad"}) == 1
+
+
+def test_guarded_escape_rules():
+    """Escape analysis: returning/yielding a bare reference to a guarded
+    MUTABLE leaks it out of the lock; copies, scalars and the ownership-
+    transfer pattern stay clean."""
+    fs = _lint_locks(
+        """
+        import threading
+        from collections import deque
+        from consensusml_tpu.analysis import guarded_by
+
+        @guarded_by("_lock", "_items", "_ring", "_n")
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self._ring = deque(maxlen=8)
+                self._n = 0
+
+            def leak(self):
+                with self._lock:
+                    return self._items          # finding
+
+            def leak_gen(self):
+                with self._lock:
+                    yield self._ring            # finding
+
+            def leak_alias(self):
+                with self._lock:
+                    out = self._items           # alias under lock
+                return out                      # finding
+
+            def ok_copy(self):
+                with self._lock:
+                    return list(self._items)
+
+            def ok_transfer(self):
+                with self._lock:
+                    out, self._items = self._items, []
+                return out
+
+            def ok_scalar(self):
+                with self._lock:
+                    return self._n
+        """
+    )
+    got = {(f.rule, f.symbol) for f in fs}
+    assert got == {
+        ("guarded-escape", "S.leak"),
+        ("guarded-escape", "S.leak_gen"),
+        ("guarded-alias-escape", "S.leak_alias"),
+    }
+
+
+def test_alias_rebound_to_copy_is_not_an_escape():
+    """`x = self._items` under the lock then `x = list(x)` before the
+    return — the very fix the escape rule recommends — is clean."""
+    fs = _lint_locks(
+        """
+        import threading
+        from consensusml_tpu.analysis import guarded_by
+
+        @guarded_by("_lock", "_items")
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def snapshot(self):
+                with self._lock:
+                    out = self._items
+                out = list(out)
+                return out
+        """
+    )
+    assert fs == [], [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# threads pass: spawn/handler inventory (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def _threads_run(tmp_path, code: str, doc: str):
+    from consensusml_tpu.analysis import threads
+
+    src = tmp_path / "mod.py"
+    src.write_text(textwrap.dedent(code))
+    docp = tmp_path / "threads.md"
+    docp.write_text(textwrap.dedent(doc))
+    return threads.run(
+        str(tmp_path), py_files=[str(src)], doc_path=str(docp),
+        report_stale=True,
+    )
+
+
+def test_unregistered_thread_is_flagged(tmp_path):
+    """The acceptance bad fixture: a thread the inventory does not list
+    is a finding; a documented one is clean."""
+    fs = _threads_run(
+        tmp_path,
+        """
+        import threading
+
+        class W:
+            def start(self):
+                t = threading.Thread(
+                    target=self._run, name="known-worker", daemon=True
+                )
+                u = threading.Thread(target=self._sneak, daemon=True)
+                t.start(); u.start()
+        """,
+        "| `mod.py:W.start:known-worker` | yes | joined | documented |\n",
+    )
+    assert _rules(fs) == ["undocumented-thread"]
+    (f,) = fs
+    assert f.detail == "self._sneak" and f.symbol == "W.start"
+
+
+def test_unregistered_handler_and_stale_doc_row(tmp_path):
+    fs = _threads_run(
+        tmp_path,
+        """
+        import signal
+
+        def arm():
+            signal.signal(signal.SIGTERM, lambda s, f: None)
+        """,
+        "| `mod.py:gone_fn:SIGUSR1` | - | | a thread of the past |\n",
+    )
+    assert _rules(fs) == ["stale-thread-doc", "undocumented-handler"]
+    assert {f.detail for f in fs} == {"SIGTERM", "mod.py:gone_fn:SIGUSR1"}
+
+
+def test_daemon_mismatch_is_flagged(tmp_path):
+    fs = _threads_run(
+        tmp_path,
+        """
+        import threading
+
+        def spawn():
+            threading.Thread(target=spin, name="w", daemon=False).start()
+        """,
+        "| `mod.py:spawn:w` | yes | joined | drifted |\n",
+    )
+    assert _rules(fs) == ["daemon-mismatch"]
+
+
+def test_thread_spawner_with_undeclared_lock_contract_is_flagged(tmp_path):
+    """A class that spawns a thread and owns a Lock but carries no
+    @guarded_by: the sharing is real, the contract is invisible."""
+    fs = _threads_run(
+        tmp_path,
+        """
+        import threading
+
+        class Undeclared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(
+                    target=self._run, name="undeclared", daemon=True
+                )
+
+        class Declared:
+            pass
+        """,
+        "| `mod.py:Undeclared.__init__:undeclared` | yes | joined | ok |\n",
+    )
+    assert _rules(fs) == ["unannotated-thread-state"]
+    assert fs[0].detail == "_lock"
+
+
+def test_repo_thread_inventory_is_complete():
+    """Acceptance: every thread/handler in the package + entry points is
+    documented in docs/threads.md, no stale rows, no undeclared lock
+    contracts — with NO baseline help."""
+    from consensusml_tpu.analysis import threads
+
+    fs = threads.check_repo(REPO)
+    assert fs == [], [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# lockorder pass: static deadlock detection (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+_ABBA_FIXTURE = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._w = Watcher()
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def scrape(self):
+            with self._lock:
+                self._w.take()      # holds Registry._lock -> Watcher._lock
+
+    class Watcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._reg = Registry()
+
+        def take(self):
+            with self._lock:
+                pass
+
+        def publish(self):
+            with self._lock:
+                self._reg.poke()    # holds Watcher._lock -> Registry._lock
+"""
+
+
+def test_abba_two_class_deadlock_is_detected_statically():
+    """The acceptance bad fixture: opposite-order acquisition across two
+    classes, composed through typed attributes and the call graph — a
+    lock-cycle finding with no thread ever run."""
+    from consensusml_tpu.analysis import lockorder
+
+    model = lockorder.analyze_sources(
+        [("fx.py", textwrap.dedent(_ABBA_FIXTURE))]
+    )
+    assert ("Registry._lock", "Watcher._lock") in model.edges
+    assert ("Watcher._lock", "Registry._lock") in model.edges
+    fs = model.findings()
+    assert _rules(fs) == ["lock-cycle"]
+    # canonical, line-number-free cycle detail => stable baseline id
+    assert fs[0].detail == "Registry._lock->Watcher._lock->Registry._lock"
+    assert fs[0].id == (
+        "lockorder:lock-cycle:fx.py:<graph>:"
+        "Registry._lock->Watcher._lock->Registry._lock"
+    )
+
+
+def test_branchy_scc_still_yields_a_witness_cycle():
+    """A cycle inside a branchy SCC (where a greedy min-successor walk
+    dead-ends) must still produce a lock-cycle finding, not an internal
+    error: edges A->B, B->C, B->D, C->B, D->A."""
+    from consensusml_tpu.analysis import lockorder
+
+    model = lockorder.LockModel()
+    for a, b in [("A", "B"), ("B", "C"), ("B", "D"), ("C", "B"),
+                 ("D", "A")]:
+        model.add_edge(a, b, "fx.py", 1, f"{a}->{b}")
+    fs = model.findings()
+    assert _rules(fs) == ["lock-cycle"], [f.render() for f in fs]
+    # the witness is a real cycle through the graph's edges
+    cyc = fs[0].detail.split("->")
+    assert cyc[0] == cyc[-1]
+    for x, y in zip(cyc, cyc[1:]):
+        assert (x, y) in model.edges, (x, y)
+
+
+def test_plain_lock_self_reentry_is_a_deadlock():
+    from consensusml_tpu.analysis import lockorder
+
+    model = lockorder.analyze_sources(
+        [(
+            "fx.py",
+            textwrap.dedent(
+                """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                """
+            ),
+        )]
+    )
+    assert _rules(model.findings()) == ["self-deadlock"]
+
+
+def test_rlock_reentry_is_exempt_self_loop():
+    """The obs/requests.py idiom: _finish_locked re-enters the RLock the
+    caller already holds — modeled as a re-entry, not a deadlock."""
+    from consensusml_tpu.analysis import lockorder
+
+    model = lockorder.analyze_sources(
+        [(
+            "fx.py",
+            textwrap.dedent(
+                """
+                import threading
+
+                class R:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def finish(self):
+                        with self._lock:
+                            self._finish_locked()
+
+                    def _finish_locked(self):
+                        with self._lock:
+                            pass
+                """
+            ),
+        )]
+    )
+    assert model.findings() == []
+    assert "R._lock" in model.reentries
+
+
+def test_repo_lock_graph_is_acyclic_and_leaf_disciplined():
+    """Acceptance: the package lock graph has NO cross-lock edges (every
+    lock is leaf-level — nothing acquires one lock while holding
+    another) and the only nesting is the request registry's documented
+    RLock re-entry. A future edge is fine; a cycle never is."""
+    from consensusml_tpu.analysis import lockorder
+
+    model = lockorder.static_model(REPO)
+    assert model.findings() == [], [
+        f.render() for f in model.findings()
+    ]
+    assert model.edges == {}, sorted(model.edges)
+    assert "RequestTraceRegistry._lock" in model.reentries
 
 
 # ---------------------------------------------------------------------------
@@ -621,8 +988,37 @@ def test_cli_all_exits_zero_on_repo():
     assert doc["counts"]["suppressed"] >= 1  # the intentional-sync inventory
     assert doc["counts"]["stale"] == 0, doc["stale_baseline"]
     assert set(doc["passes"]) == {
-        "host-sync", "locks", "docs-drift", "schedule", "jaxpr"
+        "host-sync", "locks", "threads", "lockorder", "docs-drift",
+        "schedule", "jaxpr",
     }
+    # per-pass wall time rides the JSON; the AST passes hold their
+    # absolute budget (<2 s each, gated in tools/bench_diff.py's spec)
+    secs = doc["pass_seconds"]
+    for name in ("host-sync", "locks", "threads", "lockorder", "docs-drift"):
+        assert secs[name] < 2.0, (name, secs)
+
+
+def test_cli_exits_nonzero_on_threads_bad_fixture(tmp_path):
+    """An undocumented thread in a --paths-restricted tree fails the
+    gate without dragging the repo inventory's rows in as stale."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            def spawn():
+                threading.Thread(target=spawn, daemon=True).start()
+            """
+        )
+    )
+    res = _run_cli(
+        "--threads", "--paths", str(bad), "--json", "-", timeout=120,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["undocumented-thread"]
+    assert doc["stale_baseline"] == []
 
 
 def test_cli_path_restricted_run_does_not_report_foreign_stale(tmp_path):
@@ -637,6 +1033,19 @@ def test_cli_path_restricted_run_does_not_report_foreign_stale(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
     doc = json.loads(res.stdout)
     assert doc["stale_baseline"] == []
+
+
+def test_cli_exits_nonzero_on_lockorder_bad_fixture(tmp_path):
+    """The ABBA tree fails the gate through the CLI too."""
+    bad = tmp_path / "abba.py"
+    bad.write_text(textwrap.dedent(_ABBA_FIXTURE))
+    res = _run_cli(
+        "--lockorder", "--paths", str(tmp_path), "--baseline", "none",
+        "--json", "-", timeout=120,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert any(f["rule"] == "lock-cycle" for f in doc["findings"])
 
 
 def test_cli_exits_nonzero_on_bad_fixture(tmp_path):

@@ -1,0 +1,247 @@
+"""Runtime lock-order sanitizer e2e (ISSUE 14 acceptance).
+
+The paged serving engine's submit / decode / hot-swap / scrape /
+recompute-preempt paths run CONCURRENTLY under the instrumented lock
+wrappers and the schedule-fuzz harness, and the observed acquisition
+graph must be (a) acyclic — zero lock-order inversions — and (b) a
+subgraph of the static model `analysis/lockorder.py` builds from the
+source. Unit tests for the sanitizer mechanics (cycle detection from
+sequential ABBA, RLock re-entry exemption, non-LIFO release) ride
+alongside.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.analysis import lockdep, lockorder
+from consensusml_tpu.analysis.lockdep import LockOrderSanitizer, fuzz_schedule
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# sanitizer mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_abba_order_is_flagged_without_a_deadlock():
+    """Two locks taken in opposite orders SEQUENTIALLY (no deadlock ever
+    manifests) still produce a cycle in the observed graph — the whole
+    point of the sanitizer vs waiting for the hang."""
+    with LockOrderSanitizer() as san:
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        a, b = A(), B()
+        with a._lock:
+            with b._lock:
+                pass
+        with b._lock:
+            with a._lock:
+                pass
+    assert ("A._lock", "B._lock") in san.observed_edges()
+    assert ("B._lock", "A._lock") in san.observed_edges()
+    problems = san.check()
+    assert any("cycle" in p for p in problems), problems
+
+
+def test_rlock_reentry_is_exempt_and_named():
+    with LockOrderSanitizer() as san:
+        class R:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+        R().outer()
+    assert san.check() == []
+    assert san.reentries.get("R._lock", 0) >= 1
+
+
+def test_unmodeled_edge_against_static_model_is_flagged():
+    """An observed edge between package-named locks that the static
+    model does not contain is a violation (the model drifted or the
+    code took a path the AST cannot see)."""
+    static = lockorder.analyze_sources(
+        [(
+            "fx.py",
+            "import threading\n"
+            "class X:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n",
+        )]
+    )
+    with LockOrderSanitizer() as san:
+        # fake two "package" locks by planting names directly
+        l1, l2 = threading.Lock(), threading.Lock()
+        with san._state:
+            san._names[id(l1)] = ("X._lock", True)
+            san._names[id(l2)] = ("Y._lock", True)
+        static.kinds.setdefault("X._lock", "Lock")
+        static.kinds.setdefault("Y._lock", "Lock")
+        with l1:
+            with l2:
+                pass
+    problems = san.check(static)
+    assert any("NOT in the static lock model" in p for p in problems), problems
+
+
+def test_condition_over_wrapped_rlock_waits_and_notifies():
+    """threading.Condition binds the wrapped lock's private protocol:
+    wait()/notify() must work over a sanitized RLock (and Event/Queue,
+    which build Conditions internally), with the held stack surviving
+    wait()'s full release/re-acquire."""
+    with LockOrderSanitizer() as san:
+        cond = threading.Condition(threading.RLock())
+        ev = threading.Event()
+        got = []
+
+        def waiter():
+            with cond:
+                got.append("in")
+                assert cond.wait(timeout=10)
+                got.append("woke")
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        while not got:
+            time.sleep(0.001)
+        with cond:
+            cond.notify()
+        t.join(timeout=10)
+        assert got == ["in", "woke"]
+        # Event uses Condition(Lock()) internally: same protocol path
+        ev.set()
+        assert ev.wait(timeout=1)
+    assert san.check() == []
+
+
+def test_fuzz_schedule_reraises_and_restores_interval():
+    prev = __import__("sys").getswitchinterval()
+    with pytest.raises(RuntimeError, match="boom"):
+        fuzz_schedule(
+            [lambda: None, lambda: (_ for _ in ()).throw(RuntimeError("boom"))],
+            seed=1,
+        )
+    assert __import__("sys").getswitchinterval() == prev
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: engine + watcher + scraper under fuzz
+# ---------------------------------------------------------------------------
+
+
+def _tiny_gpt2():
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=32,
+            dropout=0.0,
+        )
+    )
+
+
+def test_serving_engine_watcher_scraper_inversion_free(tmp_path):
+    """submit / decode / hot-swap / scrape / preempt concurrently under
+    the sanitizer + fuzz harness: zero observed lock-order inversions,
+    and every package-lock nesting is in the static model."""
+    from consensusml_tpu.obs import get_registry, get_request_registry
+    from consensusml_tpu.serve import Engine, ServeConfig
+    from consensusml_tpu.serve.export import _write_meta, serving_meta
+    from consensusml_tpu.serve.pool.hotswap import GenerationWatcher
+
+    model = _tiny_gpt2()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    art = str(tmp_path / "art")
+    os.makedirs(art)
+    _write_meta(art, {"generation": 1, "config_name": "lockdep-fixture"})
+
+    with LockOrderSanitizer(fuzz=0.02, seed=7) as san:
+        # constructed INSIDE the window: engine queue/events, watcher
+        # lock, and any metric child created fresh all get wrapped
+        eng = Engine(
+            model, params,
+            ServeConfig(
+                num_slots=4, max_len=32, max_new_tokens=24, num_blocks=10,
+            ),
+        )
+        loader_calls = []
+
+        def loader(path):
+            loader_calls.append(path)
+            return serving_meta(path), params, None
+
+        watcher = GenerationWatcher(
+            art, current_generation=0, poll_s=0.01, loader=loader
+        )
+        eng._watcher = watcher
+
+        def submitter():
+            # one concurrent WAVE per submitter: 8 streams contend for
+            # 4 slots and 10 blocks, forcing recompute preemption
+            rng = np.random.default_rng(1)
+            handles = [
+                eng.submit(rng.integers(0, 63, size=n).tolist(), 24)
+                for n in (3, 7, 8, 8)
+            ]
+            for h in handles:
+                assert len(h.result(timeout=120).tokens) == 24
+
+        def scraper():
+            reg, rt = get_registry(), get_request_registry()
+            for _ in range(120):
+                reg.to_prometheus()
+                rt.snapshot()
+                eng.stats()
+                time.sleep(0.002)
+
+        def swapper():
+            from consensusml_tpu.serve.export import bump_generation
+
+            for _ in range(3):
+                time.sleep(0.05)
+                bump_generation(art)
+
+        try:
+            fuzz_schedule(
+                [submitter, submitter, scraper, swapper],
+                seed=3, timeout_s=240,
+            )
+        finally:
+            eng.shutdown(drain=True, timeout=60)
+
+    # every path actually ran: streams completed (asserted inline), the
+    # watcher staged + the engine flipped at least one generation, the
+    # tight pool forced at least one recompute preemption
+    stats = eng.stats()
+    assert eng.generation >= 1 and loader_calls, (
+        eng.generation, loader_calls
+    )
+    assert stats["evictions"] >= 1, stats
+    assert san.acquisitions > 100
+    # THE acceptance assertions: acyclic observed order, and observed
+    # package-lock nesting ⊆ the static lockorder model
+    san.assert_clean(static=lockorder.static_model(REPO))

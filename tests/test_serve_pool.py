@@ -386,9 +386,10 @@ def test_watcher_stages_new_generations_and_rejects_backwards(tmp_path):
 
     from consensusml_tpu.obs import get_registry
 
+    w._lock = threading.Lock()  # first: the generation property locks
     w.path, w.poll_s, w.generation = art, 999.0, 1
     w.stage_draft = False
-    w._loader, w._staged, w._lock = loader, None, threading.Lock()
+    w._loader, w._staged = loader, None
     w._rejected_gen, w._flip_rejected = None, None
     reg = get_registry()
     w._m_staged = reg.counter("test_pool_w_staged", "t")

@@ -107,6 +107,25 @@ DEFAULT_SPEC = [
      "bound": 60000.0},
     {"key": "attribution.compile_ms.spec_verify", "direction": "max",
      "bound": 60000.0},
+    # concurrency-correctness plane (ISSUE 14, docs/static_analysis.md):
+    # the cml-check AST passes hold ABSOLUTE wall budgets (<2 s each on
+    # CPU — a pass suddenly 10x slower is a regression even when its
+    # findings stay clean), the lockdep sanitizer fuzz smoke stays
+    # under its 30 s CPU budget, and the passes report ZERO active
+    # (un-baselined) findings
+    {"key": "analysis.pass_seconds.host_sync", "direction": "max",
+     "bound": 2.0},
+    {"key": "analysis.pass_seconds.locks", "direction": "max",
+     "bound": 2.0},
+    {"key": "analysis.pass_seconds.threads", "direction": "max",
+     "bound": 2.0},
+    {"key": "analysis.pass_seconds.lockorder", "direction": "max",
+     "bound": 2.0},
+    {"key": "analysis.pass_seconds.docs_drift", "direction": "max",
+     "bound": 2.0},
+    {"key": "analysis.active_findings", "direction": "max", "bound": 0.0},
+    {"key": "analysis.lockdep_smoke_seconds", "direction": "max",
+     "bound": 30.0},
 ]
 
 

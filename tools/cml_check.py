@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """cml-check: static analysis gate for the gossip training stack.
 
-Runs the five analysis passes (see docs/static_analysis.md) and exits
+Runs the seven analysis passes (see docs/static_analysis.md) and exits
 non-zero on any finding not suppressed by the baseline file:
 
     python tools/cml_check.py --all                # the tier-1 gate
@@ -24,10 +24,25 @@ Passes:
                 callback in the block-index computation, no f64,
                 step-over-step canonical-jaxpr stability per stage =
                 zero serving recompiles
-  --locks       lock-discipline race lint over @guarded_by classes
+  --locks       lock-discipline race lint over @guarded_by classes:
+                unguarded access, bare acquire/release, guarded-
+                reference escapes
+  --threads     thread-and-handler inventory: every threading.Thread /
+                signal.signal / excepthook site cross-checked against
+                docs/threads.md, plus thread-spawning classes with
+                undeclared lock contracts
+  --lockorder   static lock-ordering graph over the package: an ABBA
+                cycle or a plain-Lock self-re-entry is a potential
+                deadlock finding (RLock re-entry is an exempt
+                self-loop); the graph doubles as the static model the
+                runtime sanitizer (analysis/lockdep.py) checks
+                observed orders against
   --docs        docs-drift: every consensusml_* metric family emitted
                 in code must appear in docs/observability.md, and doc
                 entries no code emits are flagged stale
+
+Each run prints a per-pass wall-time line ([time] ...); the AST passes
+are budgeted <2 s each in tools/bench_diff.py's spec.
 
 Exit codes: 0 clean (or everything suppressed), 1 active findings,
 2 internal error. CPU-only, trace-only: safe on any dev box and in CI.
@@ -74,31 +89,95 @@ def _force_cpu():
         pass
 
 
-def run_passes(selected: list[str], roots: list[str]):
+def _expand_py(roots: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in roots:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            ]
+            out.extend(
+                os.path.join(dirpath, f)
+                for f in sorted(filenames)
+                if f.endswith(".py")
+            )
+    return out
+
+
+def run_passes(selected: list[str], roots: list[str], restricted: bool = False):
+    """-> (findings, per-pass wall seconds). The timing line each pass
+    gets in the report is an absolute budget bench_diff gates (AST
+    passes <2 s); a pass suddenly costing 10x is a regression even when
+    its findings stay clean."""
+    import time as _time
+
     findings = []
+    timings: dict[str, float] = {}
+
+    def timed(name, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        timings[name] = _time.perf_counter() - t0
+        return out
+
     if "host-sync" in selected:
         from consensusml_tpu.analysis import host_sync
 
-        findings += host_sync.lint_paths(roots, _REPO_ROOT)
+        findings += timed(
+            "host-sync", lambda: host_sync.lint_paths(roots, _REPO_ROOT)
+        )
     if "locks" in selected:
         from consensusml_tpu.analysis import locks
 
-        findings += locks.lint_paths(roots, _REPO_ROOT)
+        findings += timed(
+            "locks", lambda: locks.lint_paths(roots, _REPO_ROOT)
+        )
+    if "threads" in selected:
+        from consensusml_tpu.analysis import threads
+
+        if restricted:
+            findings += timed(
+                "threads",
+                lambda: threads.run(
+                    _REPO_ROOT, py_files=_expand_py(roots)
+                ),
+            )
+        else:
+            findings += timed(
+                "threads", lambda: threads.check_repo(_REPO_ROOT)
+            )
+    if "lockorder" in selected:
+        from consensusml_tpu.analysis import lockorder
+
+        if restricted:
+            findings += timed(
+                "lockorder",
+                lambda: lockorder.check_paths(roots, _REPO_ROOT),
+            )
+        else:
+            findings += timed(
+                "lockorder", lambda: lockorder.check_repo(_REPO_ROOT)
+            )
     if "docs-drift" in selected:
         from consensusml_tpu.analysis import docs_drift
 
-        findings += docs_drift.check_repo(_REPO_ROOT)
+        findings += timed(
+            "docs-drift", lambda: docs_drift.check_repo(_REPO_ROOT)
+        )
     if "schedule" in selected:
         _force_cpu()
         from consensusml_tpu.analysis import schedule
 
-        findings += schedule.run_builtin()
+        findings += timed("schedule", schedule.run_builtin)
     if "jaxpr" in selected:
         _force_cpu()
         from consensusml_tpu.analysis import jaxpr_contracts
 
-        findings += jaxpr_contracts.check_all_configs()
-    return findings
+        findings += timed("jaxpr", jaxpr_contracts.check_all_configs)
+    return findings, timings
 
 
 def write_baseline(path: str, findings) -> None:
@@ -118,11 +197,13 @@ def main(argv=None) -> int:
         prog="cml-check", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("--all", action="store_true", help="run all five passes")
+    ap.add_argument("--all", action="store_true", help="run all seven passes")
     ap.add_argument("--host-sync", action="store_true")
     ap.add_argument("--schedule", action="store_true")
     ap.add_argument("--jaxpr", action="store_true")
     ap.add_argument("--locks", action="store_true")
+    ap.add_argument("--threads", action="store_true")
+    ap.add_argument("--lockorder", action="store_true")
     ap.add_argument("--docs", action="store_true")
     ap.add_argument(
         "--paths", nargs="*", default=None,
@@ -148,6 +229,8 @@ def main(argv=None) -> int:
         for name, on in (
             ("host-sync", args.host_sync),
             ("locks", args.locks),
+            ("threads", args.threads),
+            ("lockorder", args.lockorder),
             ("docs-drift", args.docs),
             ("schedule", args.schedule),
             ("jaxpr", args.jaxpr),
@@ -159,7 +242,9 @@ def main(argv=None) -> int:
     roots = args.paths if args.paths else AST_PASS_PATHS
 
     try:
-        findings = run_passes(selected, roots)
+        findings, timings = run_passes(
+            selected, roots, restricted=args.paths is not None
+        )
     except Exception as e:
         print(f"cml-check: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -189,7 +274,19 @@ def main(argv=None) -> int:
         parts = sid.split(":")
         if parts[0] not in selected:
             return False
-        if parts[0] in ("host-sync", "locks") and len(parts) > 2:
+        if (
+            parts[0] == "threads"
+            and len(parts) > 1
+            and parts[1] == "stale-thread-doc"
+            and args.paths is not None
+        ):
+            # restricted runs never emit stale-doc findings at all
+            # (report_stale off), so the entry cannot be re-found
+            return False
+        path_scoped = parts[0] in (
+            "host-sync", "locks", "threads", "lockorder"
+        )
+        if path_scoped and args.paths is not None and len(parts) > 2:
             f = parts[2]
             return any(
                 f == r or f.startswith(r.rstrip(os.sep) + os.sep) or r == "."
@@ -202,8 +299,17 @@ def main(argv=None) -> int:
     report = render_report(
         active, suppressed, stale, passes_run=selected
     )
+    # per-pass wall time: the AST passes carry absolute budgets in
+    # tools/bench_diff.py's spec (<2 s each) — a pass that silently got
+    # 10x slower is a regression even with zero findings
+    report += "".join(
+        f"\n[time] {name}: {timings.get(name, 0.0):.2f}s"
+        for name in selected
+    )
     if args.json:
-        out = to_json(active, suppressed, stale, passes_run=selected)
+        out = to_json(
+            active, suppressed, stale, passes_run=selected, timings=timings
+        )
         if args.json == "-":
             print(out)
         else:
