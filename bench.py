@@ -1278,7 +1278,59 @@ def _obs_bench() -> dict:
         ),
     }
     out.update(_request_tracing_bench())
+    out.update(_history_alert_bench(round_ms, cadence))
     return out
+
+
+def _history_alert_bench(gossip_round_ms: float, cadence: int) -> dict:
+    """History+alert tick cost and the zero-false-firing gate
+    (docs/observability.md "Alerting & history", gated by
+    tools/bench_diff.py).
+
+    Runs AFTER :func:`_request_tracing_bench`, so the PROCESS registry
+    carries a real healthy serving run's families (TTFT/inter-token
+    distributions, queue depth, pool gauges, the engine-loop heartbeat)
+    plus this subprocess's consensus/link/health families — the honest
+    surface a production tick iterates. Measures one ``record()`` (every
+    family sampled into the rings) and one default-ruleset
+    ``evaluate()``, amortizes them at telemetry cadence against the
+    measured gossip round, and asserts the DEFAULT ruleset fires ZERO
+    alerts on this healthy run."""
+    from consensusml_tpu.obs import AlertEngine, MetricsHistory, get_registry
+    from consensusml_tpu.obs.tracer import SpanTracer
+
+    reg = get_registry()
+    hist = MetricsHistory(reg)
+    engine = AlertEngine(
+        hist, registry=reg, tracer=SpanTracer(), quiet=True
+    )
+    hist.record()
+    engine.evaluate()  # warm: series creation, rule-state dicts
+    reps = 50
+    t0 = time.time()
+    for _ in range(reps):
+        hist.record()
+    record_ms = 1000 * (time.time() - t0) / reps
+    t0 = time.time()
+    for _ in range(reps):
+        engine.evaluate()
+    eval_ms = 1000 * (time.time() - t0) / reps
+    firing = engine.firing()
+    per_round_ms = (record_ms + eval_ms) / cadence
+    return {
+        "history_series": len(hist),
+        "history_record_ms": round(record_ms, 4),
+        "alert_rules": len(engine.rules),
+        "alert_eval_ms": round(eval_ms, 4),
+        "history_alert_per_round_ms": round(per_round_ms, 4),
+        "alerting_overhead_pct": round(
+            100 * per_round_ms / max(gossip_round_ms, 1e-9), 3
+        ),
+        # MUST be 0: a default ruleset that pages on a healthy run is
+        # broken (bench_diff gates it at 0)
+        "alerts_fired_on_healthy_run": len(firing),
+        "alerts_fired_detail": [a["rule"] for a in firing],
+    }
 
 
 def _request_tracing_bench() -> dict:

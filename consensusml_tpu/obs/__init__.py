@@ -41,6 +41,21 @@ attribution", docs/memory.md "Reconciliation"):
   ``jax.profiler`` capture of a RUNNING train loop or serving engine
   (single-flight, bounded dir rotation).
 
+The ALERTING plane turns retained signals into fire/clear objectives
+(docs/observability.md "Alerting & history"):
+
+- :mod:`~consensusml_tpu.obs.history` — bounded in-process time-series
+  rings over the registry (``rate``/``increase``/windowed percentiles
+  from histogram deltas/last-N dumps, ``consensusml_history_*``
+  accounting);
+- :mod:`~consensusml_tpu.obs.alerts` — declarative ``SloSpec`` /
+  ``AlertRule`` engine (thresholds, Google-SRE multi-window burn rates,
+  heartbeat staleness) with firing/resolved lifecycle,
+  ``consensusml_alert_*`` families, and a bundled default ruleset;
+- ``GET /alerts`` / ``/query`` / ``/healthz`` on the live HTTP plane;
+  alert state + history digests ride cluster snapshots and
+  flight-recorder dumps.
+
 The CLUSTER plane builds on them (docs/observability.md "Cluster view"):
 
 - :mod:`~consensusml_tpu.obs.links` — per-link probes feeding
@@ -70,7 +85,21 @@ from consensusml_tpu.obs.costs import (  # noqa: F401
     ExecutableCost,
     get_cost_ledger,
 )
+from consensusml_tpu.obs.alerts import (  # noqa: F401
+    Alert,
+    AlertEngine,
+    AlertRule,
+    SloSpec,
+    default_ruleset,
+    get_alert_engine,
+    peek_alert_engine,
+)
 from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
+from consensusml_tpu.obs.history import (  # noqa: F401
+    MetricsHistory,
+    get_history,
+    peek_history,
+)
 from consensusml_tpu.obs.httpd import MetricsServer  # noqa: F401
 from consensusml_tpu.obs.memviz import (  # noqa: F401
     HbmAccountant,

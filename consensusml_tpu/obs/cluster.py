@@ -61,6 +61,8 @@ class ClusterWriter:
         registry: MetricsRegistry | None = None,
         world_size: int | None = None,
         tracer=None,
+        history=None,
+        alerts=None,
     ):
         from consensusml_tpu.obs.tracer import get_tracer
 
@@ -69,6 +71,14 @@ class ClusterWriter:
         self.role = role
         self.world_size = world_size
         self.registry = registry if registry is not None else get_registry()
+        # alert/history digest sources: explicit wiring wins; a writer
+        # over the GLOBAL registry falls back to peeking the process
+        # singletons (so the train loop's armed plane lands in snapshots
+        # without threading two more handles through every call site) —
+        # a custom registry never picks up the global plane's digests
+        self.history = history
+        self.alerts = alerts
+        self._peek_global = registry is None
         # span-ring digest source: per-round phase rows for the merged
         # round timeline (tracer disabled => no digest in the snapshot)
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -107,6 +117,18 @@ class ClusterWriter:
             digest = self.tracer.digest()
             if digest["spans"]:
                 doc["span_digest"] = digest
+        alerts = self.alerts
+        history = self.history
+        if self._peek_global:
+            from consensusml_tpu.obs.alerts import peek_alert_engine
+            from consensusml_tpu.obs.history import peek_history
+
+            alerts = alerts or peek_alert_engine()
+            history = history or peek_history()
+        if alerts is not None:
+            doc["alerts"] = alerts.snapshot()
+        if history is not None:
+            doc["history"] = history.digest(points=32)
         if extra:
             doc.update(extra)
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -195,8 +217,15 @@ def _merge_hist(a: dict[str, Any] | None, b: dict[str, Any]) -> dict[str, Any]:
 
 
 def _metric(doc: dict, name: str, default=None):
-    v = doc.get("metrics", {}).get(name, default)
+    v = (doc.get("metrics") or {}).get(name, default)
     return default if v is None else v
+
+
+def _age_s(doc: dict, now: float) -> float:
+    """Heartbeat age, tolerant of a partial snapshot with the field
+    missing or malformed (treated as just-written: age 0)."""
+    hb = _finite(doc.get("heartbeat_s"))
+    return round(now - (hb if hb is not None else now), 3)
 
 
 def _finite(v) -> float | None:
@@ -239,7 +268,7 @@ def _requests_section(snaps: list[dict[str, Any]], top: int = 8) -> dict[str, An
                 }
     rows: list[dict[str, Any]] = []
     for s in snaps:
-        for key, vd in s.get("metrics", {}).items():
+        for key, vd in (s.get("metrics") or {}).items():
             name, _labels = parse_metric_key(key)
             side = _SLO_SIDES.get(name)
             if side is None or not isinstance(vd, dict):
@@ -390,6 +419,104 @@ def _attribution_section(snaps: list[dict[str, Any]]) -> list[dict[str, Any]]:
     return out
 
 
+def _alerts_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Fleet-wide alert view: every snapshot's firing alerts merged,
+    deduplicated by (rule, series-with-labels) — the same breach seen
+    from N ranks is ONE row naming all N — ordered worst-first (the
+    alert engine's own ordering: severity, then longest-firing). None
+    when no snapshot carries an alert plane (partial/old snapshots stay
+    renderable)."""
+    from consensusml_tpu.obs.alerts import worst_first_key
+
+    rows: dict[tuple[str, str], dict[str, Any]] = {}
+    reporting = 0
+    events: list[dict[str, Any]] = []
+    resolved_total = 0
+    for s in snaps:
+        al = s.get("alerts")
+        if not isinstance(al, dict):
+            continue
+        reporting += 1
+        resolved_total += len(al.get("resolved_recent") or [])
+        who = f"{s.get('role') or 'rank'}-{s.get('rank')}"
+        for ev in al.get("events_recent") or []:
+            events.append(dict(ev, reporter=who))
+        for a in al.get("firing") or []:
+            key = (a.get("rule") or "", a.get("series") or "")
+            row = rows.get(key)
+            if row is None:
+                row = rows[key] = dict(a, reporters=[])
+            else:
+                # keep the worst view of the shared breach: earliest
+                # fire time, and the value on the bad side of the
+                # rule's direction (min for "below" breaches)
+                if (a.get("fired_s") or math.inf) < (
+                    row.get("fired_s") or math.inf
+                ):
+                    row["fired_s"] = a.get("fired_s")
+                    row["since_s"] = a.get("since_s")
+                v, rv = a.get("value"), row.get("value")
+                if v is not None and (
+                    rv is None
+                    or (v < rv if row.get("direction") == "below" else v > rv)
+                ):
+                    row["value"] = v
+            row["reporters"].append(who)
+    if not reporting:
+        return None
+    firing = sorted(rows.values(), key=worst_first_key)
+    events.sort(key=lambda e: e.get("time_s") or 0.0)
+    return {
+        "ranks_reporting": reporting,
+        "firing": firing,
+        "firing_total": len(firing),
+        "resolved_recent_total": resolved_total,
+        "events_recent": events[-16:],
+    }
+
+
+def _history_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Per-series sparkline rows from every snapshot's history digest:
+    one row per (series, role, rank), carrying the digest's derived
+    points (gauge value / counter rate / histogram interval-p99) so the
+    report can render client-vs-server trends side by side. None when
+    no snapshot carries a digest."""
+    rows: list[dict[str, Any]] = []
+    reporting = 0
+    for s in snaps:
+        digest = s.get("history")
+        if not isinstance(digest, dict):
+            continue
+        reporting += 1
+        for row in digest.get("series") or []:
+            if not isinstance(row, dict) or not row.get("series"):
+                continue
+            rows.append(
+                {
+                    "series": row["series"],
+                    "kind": row.get("kind"),
+                    "role": s.get("role"),
+                    "rank": s.get("rank"),
+                    "points": row.get("points") or [],
+                    "last": row.get("last"),
+                    "min": row.get("min"),
+                    "max": row.get("max"),
+                }
+            )
+    if not reporting:
+        return None
+    rows.sort(
+        key=lambda r: (
+            r["series"], str(r.get("role") or ""), r.get("rank") or 0
+        )
+    )
+    return {
+        "ranks_reporting": reporting,
+        "series": rows,
+        "series_total": len(rows),
+    }
+
+
 def _hbm_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
     """The three-way HBM reconciliation gauges (obs/memviz.py), worst
     rank per side — plus per-pair drift. None when no rank reconciled."""
@@ -454,7 +581,7 @@ def aggregate(
             "rank": s.get("rank"),
             "file": s.get("_file"),
             "round": s.get("round"),
-            "heartbeat_age_s": round(now - s.get("heartbeat_s", now), 3),
+            "heartbeat_age_s": _age_s(s, now),
             "rounds_total": _metric(s, "consensusml_rounds_total", 0.0),
             "wire_bytes_total": _metric(s, "consensusml_wire_bytes_total", 0.0),
             "round_latency": (
@@ -482,7 +609,7 @@ def aggregate(
         rank_rows.append(row)
         # merge every rank's per-edge families (a rank sees its own
         # probes; in single-controller runs rank 0 sees every edge)
-        for key, vd in s.get("metrics", {}).items():
+        for key, vd in (s.get("metrics") or {}).items():
             name, labels = parse_metric_key(key)
             if "src" not in labels or "dst" not in labels:
                 continue
@@ -616,7 +743,7 @@ def aggregate(
     swarm_epoch = None
     swarm_members = None
     for s in ranks:
-        for key, vd in s.get("metrics", {}).items():
+        for key, vd in (s.get("metrics") or {}).items():
             name, labels = parse_metric_key(key)
             if name == "consensusml_swarm_events_total" and "kind" in labels:
                 f = _finite(vd)
@@ -692,10 +819,10 @@ def aggregate(
             "role": s.get("role"),
             "rank": s.get("rank"),
             "file": s.get("_file"),
-            "heartbeat_age_s": round(now - s.get("heartbeat_s", now), 3),
+            "heartbeat_age_s": _age_s(s, now),
             "metrics": {},
         }
-        for key, vd in s.get("metrics", {}).items():
+        for key, vd in (s.get("metrics") or {}).items():
             if isinstance(vd, dict):
                 row["metrics"][key] = hist_stats(vd)
             else:
@@ -726,6 +853,13 @@ def aggregate(
         # "Cost attribution"; empty/None without --cost-ledger)
         "attribution": _attribution_section(ranks + others),
         "hbm": _hbm_section(ranks + others),
+        # the alert plane: fleet-wide firing alerts deduped by
+        # (rule, series), worst-first, with per-series history
+        # sparkline rows (docs/observability.md "Alerting & history");
+        # None when no snapshot carries the sections — partial or
+        # pre-alert-plane snapshots keep aggregating
+        "alerts": _alerts_section(ranks + others),
+        "history": _history_section(ranks + others),
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

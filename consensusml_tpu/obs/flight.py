@@ -54,6 +54,8 @@ class FlightRecorder:
         tracer: SpanTracer | None = None,
         registry: MetricsRegistry | None = None,
         requests: RequestTraceRegistry | None = None,
+        history=None,
+        alerts=None,
     ):
         self.out_dir = out_dir
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -61,6 +63,15 @@ class FlightRecorder:
         self.requests = (
             requests if requests is not None else get_request_registry()
         )
+        # history rings / alert engine: explicit wiring wins; a recorder
+        # over the GLOBAL registry additionally PEEKS at the process
+        # singletons at dump time so an armed alert plane always lands
+        # in the post-mortem (never creating one as a side effect) — a
+        # custom-registry recorder must not embed the global plane's
+        # digests next to a different registry's metrics
+        self.history = history
+        self.alerts = alerts
+        self._peek_global = registry is None
         self._installed = False
         self._prev_excepthook = None
         self._prev_thread_hook = None
@@ -97,6 +108,21 @@ class FlightRecorder:
                 # each had gotten) — see docs/observability.md
                 "request_traces": self.requests.snapshot(),
             }
+            alerts = self.alerts
+            hist = self.history
+            if self._peek_global:
+                from consensusml_tpu.obs.alerts import peek_alert_engine
+                from consensusml_tpu.obs.history import peek_history
+
+                alerts = alerts or peek_alert_engine()
+                hist = hist or peek_history()
+            if alerts is not None:
+                # what was already WRONG when the process died
+                doc["alerts"] = alerts.snapshot()
+            if hist is not None:
+                # the last-N trend of every series — whether the breach
+                # was a cliff or a slow burn
+                doc["history"] = hist.digest()
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(doc, f)

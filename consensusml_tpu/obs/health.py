@@ -28,6 +28,14 @@ Feeds the ``consensusml_health_*`` gauge family (measured decay, bound,
 distance, violation flag) and ``consensusml_health_anomalies_total``;
 anomalies also land as tracer instant events and a stderr log line that
 names the round, the measured rate and the bound — the "loud" part.
+With an :class:`~consensusml_tpu.obs.alerts.AlertEngine` attached
+(``alerts=``, the train loop wires it when telemetry is on) the episode
+log routes through :meth:`AlertEngine.notify` instead of a bespoke
+``print``, so the episode shows up in ``/alerts`` and the cluster
+report's event stream; the fire/clear LIFECYCLE rides the
+``consensusml_health_bound_violation`` gauge via the default ruleset's
+``consensus-health-violation`` rule (docs/observability.md
+"Alerting & history").
 """
 
 from __future__ import annotations
@@ -74,12 +82,16 @@ class ConsensusHealthMonitor:
         sustain: int = 3,
         window: int = 16,
         floor: float = 1e-9,
+        alerts=None,
     ):
         if sustain < 1:
             raise ValueError(f"sustain must be >= 1, got {sustain}")
         self.topology = topology
         self.registry = registry if registry is not None else get_registry()
         self.tracer = tracer if tracer is not None else get_tracer()
+        # optional AlertEngine: episode logs route through its event
+        # stream instead of a bespoke stderr print (module docstring)
+        self.alerts = alerts
         self.strict = strict
         self.tolerance = float(tolerance)
         self.sustain = int(sustain)
@@ -171,7 +183,7 @@ class ConsensusHealthMonitor:
             self.anomalies.append(record)
             if self._streak == self.sustain:  # episode start: be loud
                 self._m_anomalies.inc()
-                print(
+                msg = (
                     "consensus-health ANOMALY: "
                     f"{record['kind']} at round {rnd} — consensus distance "
                     f"{d:.4g} decayed at {ratio:.4f}/round for "
@@ -179,10 +191,16 @@ class ConsensusHealthMonitor:
                     f", spectral bound {self.bound:.4f}, topology "
                     f"{self.topology.name}); a replica is likely diverging "
                     "or a link is biasing the mean "
-                    "(consensusml_tpu.obs.health)",
-                    file=sys.stderr,
-                    flush=True,
+                    "(consensusml_tpu.obs.health)"
                 )
+                if self.alerts is not None:
+                    self.alerts.notify(
+                        "consensus-health", msg, severity="page",
+                        round=int(rnd), kind=record["kind"],
+                        ratio=record["ratio"], bound=record["bound"],
+                    )
+                else:
+                    print(msg, file=sys.stderr, flush=True)
             self.tracer.instant(
                 "health.anomaly",
                 round=rnd,

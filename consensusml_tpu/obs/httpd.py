@@ -13,6 +13,15 @@ textfile writer uses, freshly rendered per GET, so a Prometheus scraper
 - ``GET /requests`` — the request-trace registry snapshot JSON
   (in-flight + recent completed, docs/observability.md "Request
   tracing");
+- ``GET /alerts``   — the alert engine's snapshot (firing worst-first,
+  recent resolutions, plane events — docs/observability.md "Alerting &
+  history");
+- ``GET /query?series=NAME[&window=S][&n=N]`` — one history series:
+  derived points (rate / p99-per-interval / raw gauge), windowed stats;
+- ``GET /healthz``  — the readiness probe a fleet router polls: process
+  up, last history-tick age, firing-alert count. Returns **503** when
+  this server OWNS the tick cadence (``tick_s > 0``) and ticks stopped
+  landing — a wedged serving process stops being routable;
 - ``GET /profile?ms=N`` — an ON-DEMAND ``jax.profiler`` capture of the
   next N milliseconds of whatever this process is doing (a live train
   loop, a serving engine mid-traffic) — no restart, no ``--profile-dir``
@@ -34,12 +43,20 @@ Surfaces: ``train.py --metrics-port N`` and
 from :attr:`MetricsServer.port`). Render cost is paid by the scraper's
 thread — the train/serve hot paths only ever touch the per-metric locks
 they already hold for a few µs per update.
+
+History/alert state is OPT-IN wiring (``history=``/``alerts=``): the
+train loop drives ``record()``/``evaluate()`` from its own telemetry
+tick and passes the engines in for surfacing only; a serving process
+has no loop to ride, so ``tick_s > 0`` starts the ``obs-ticker``
+daemon thread (docs/threads.md) that drives them at cadence —
+``ServeServer(metrics_port=...)`` does exactly that.
 """
 
 from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 import shutil
 import tempfile
@@ -61,6 +78,20 @@ __all__ = ["MetricsServer"]
 
 PROFILE_MAX_MS = 30_000  # one capture may stall a scraper thread this long
 PROFILE_DEFAULT_MS = 500
+
+
+def _jsonsafe(doc):
+    """Non-finite floats -> null: ``json.dumps`` would emit bare
+    ``NaN``/``Infinity``, which strict JSON parsers (a Go router polling
+    /healthz, jq) reject. Applied to the alert/history endpoint docs,
+    whose empty-window stats are NaN by construction."""
+    if isinstance(doc, float) and not math.isfinite(doc):
+        return None
+    if isinstance(doc, dict):
+        return {k: _jsonsafe(v) for k, v in doc.items()}
+    if isinstance(doc, (list, tuple)):
+        return [_jsonsafe(v) for v in doc]
+    return doc
 
 
 def _xprof_summary_json(trace_json: str) -> dict | None:
@@ -101,10 +132,20 @@ class MetricsServer:
         requests: RequestTraceRegistry | None = None,
         profile_dir: str | None = None,
         profile_quota: int = 4,
+        history=None,
+        alerts=None,
+        tick_s: float = 0.0,
     ):
         registry = registry if registry is not None else get_registry()
         tracer = tracer if tracer is not None else get_tracer()
         requests = requests if requests is not None else get_request_registry()
+        # MetricsHistory / AlertEngine (obs.history / obs.alerts): when
+        # wired, /alerts and /query go live and /healthz reports tick
+        # freshness; tick_s > 0 additionally makes THIS server drive
+        # record()/evaluate() on the obs-ticker thread
+        self.history = history
+        self.alerts = alerts
+        self.tick_s = float(tick_s)
         server = self
 
         # /profile state: one capture at a time, process-wide semantics
@@ -128,40 +169,58 @@ class MetricsServer:
             "double-start 503s)",
         )
 
+        # the one JSON content type every JSON endpoint sends — /metrics
+        # alone stays Prometheus text exposition
+        JSON_CTYPE = "application/json; charset=utf-8"
+
         class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, code: int, doc) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", JSON_CTYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self) -> None:  # noqa: N802 - stdlib API name
                 url = urlparse(self.path)
                 path = url.path
                 if path in ("/metrics", "/"):
                     body = registry.to_prometheus().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif path == "/traces":
-                    body = json.dumps(
-                        merged_chrome_trace(tracer, requests)
-                    ).encode()
-                    ctype = "application/json"
-                elif path == "/requests":
-                    body = json.dumps(requests.snapshot()).encode()
-                    ctype = "application/json"
-                elif path == "/profile":
-                    code, doc = server._profile(parse_qs(url.query))
-                    body = json.dumps(doc).encode()
-                    self.send_response(code)
-                    self.send_header("Content-Type", "application/json")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                else:
-                    self.send_error(
-                        404, "try /metrics, /traces, /requests, /profile"
+                if path == "/traces":
+                    self._send_json(
+                        200, merged_chrome_trace(tracer, requests)
                     )
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                elif path == "/requests":
+                    self._send_json(200, requests.snapshot())
+                elif path == "/alerts":
+                    code, doc = server._alerts_doc()
+                    self._send_json(code, _jsonsafe(doc))
+                elif path == "/query":
+                    code, doc = server._query_doc(parse_qs(url.query))
+                    self._send_json(code, _jsonsafe(doc))
+                elif path == "/healthz":
+                    code, doc = server._healthz_doc()
+                    self._send_json(code, _jsonsafe(doc))
+                elif path == "/profile":
+                    self._send_json(*server._profile(parse_qs(url.query)))
+                else:
+                    self._send_json(
+                        404,
+                        {
+                            "error": "try /metrics, /traces, /requests, "
+                                     "/alerts, /query, /healthz, /profile"
+                        },
+                    )
 
             def log_message(self, *args) -> None:
                 pass  # scrapes are not log lines
@@ -170,12 +229,101 @@ class MetricsServer:
         self._httpd.daemon_threads = True
         self.address: tuple[str, int] = self._httpd.server_address[:2]
         self.port: int = self.address[1]
+        # /healthz state must exist before the first handler can run
+        self._started_s = time.time()
+        self._tick_stop = threading.Event()
+        self._ticker = None
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="obs-metrics-http",
             daemon=True,
         )
         self._thread.start()
+        # obs-ticker (docs/threads.md): serving processes have no train
+        # loop to ride, so the server itself drives history.record() +
+        # alerts.evaluate() at tick_s cadence; the thread only touches
+        # the locked history/alert/registry paths
+        if self.tick_s > 0 and (
+            self.history is not None or self.alerts is not None
+        ):
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name="obs-ticker", daemon=True
+            )
+            self._ticker.start()
+
+    def _tick_loop(self) -> None:
+        while not self._tick_stop.wait(self.tick_s):
+            try:
+                if self.history is not None:
+                    self.history.record()
+                if self.alerts is not None:
+                    self.alerts.evaluate()
+            except Exception:
+                # a transient export failure must not kill the cadence;
+                # /healthz staleness catches a persistently broken tick
+                pass
+
+    # -- /alerts /query /healthz ------------------------------------------
+
+    def _alerts_doc(self) -> tuple[int, dict]:
+        if self.alerts is None:
+            return 200, {"enabled": False, "firing": [], "firing_total": 0}
+        doc = self.alerts.snapshot()
+        doc["enabled"] = True
+        return 200, doc
+
+    def _query_doc(self, query: dict) -> tuple[int, dict]:
+        if self.history is None:
+            return 404, {"error": "no metrics history wired on this server"}
+        series = (query.get("series") or [None])[0]
+        if not series:
+            return 400, {
+                "error": "series is required: /query?series=NAME"
+                         "[&window=SECONDS][&n=POINTS]",
+                "series_known": self.history.keys(),
+            }
+        try:
+            window = query.get("window")
+            window_s = float(window[0]) if window else None
+            n = query.get("n")
+            points = int(n[0]) if n else None
+        except (TypeError, ValueError):
+            return 400, {"error": "window/n must be numeric"}
+        doc = self.history.query(series, window_s=window_s, n=points)
+        if doc is None:
+            return 404, {
+                "error": f"unknown series {series!r}",
+                "series_known": self.history.keys(),
+            }
+        return 200, doc
+
+    def _healthz_doc(self) -> tuple[int, dict]:
+        """Readiness: 200 while the process (and, when this server owns
+        the cadence, its obs tick) is live; 503 when an owned tick went
+        stale — the signal a fleet router stops routing on."""
+        now = time.time()
+        age = None
+        if self.history is not None:
+            last = self.history.last_record_s
+            if last == last:  # not NaN: at least one record landed
+                age = round(now - last, 3)
+            else:
+                age = round(now - self._started_s, 3)
+        firing = len(self.alerts.firing()) if self.alerts is not None else 0
+        ok = True
+        if self.tick_s > 0 and age is not None:
+            ok = age <= max(5.0 * self.tick_s, 10.0)
+        return (200 if ok else 503), {
+            "ok": ok,
+            "time_s": now,
+            "pid": os.getpid(),
+            "tick_s": self.tick_s if self.tick_s > 0 else None,
+            "last_tick_age_s": age,
+            "firing_alerts": firing,
+            "history_series": (
+                len(self.history) if self.history is not None else 0
+            ),
+        }
 
     # -- /profile ---------------------------------------------------------
 
@@ -269,6 +417,9 @@ class MetricsServer:
         return f"http://{self.address[0]}:{self.port}{path}"
 
     def close(self) -> None:
+        self._tick_stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=max(2.0, 2 * self.tick_s))
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)

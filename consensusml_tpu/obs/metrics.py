@@ -295,6 +295,14 @@ class Histogram(_Metric):
         with self._lock:
             return list(self._counts), self._sum, self._count
 
+    def raw(self) -> tuple[tuple[float, ...], tuple[int, ...], float, int]:
+        """``(bucket_edges, per-bucket counts incl. the +Inf slot, sum,
+        count)`` as one consistent locked read — the numeric form the
+        history ring samples (``value_dict`` renders edges as strings
+        for JSON; delta math wants floats)."""
+        counts, total, n = self._snapshot()
+        return self.buckets, tuple(counts), total, n
+
     def expose(self) -> list[str]:
         counts, total, n = self._snapshot()
         lines = []

@@ -277,6 +277,16 @@ class Engine:
             "consensusml_pool_evictions_total",
             "streams preempted by recompute on block-pool exhaustion",
         )
+        # loop liveness: set every engine-thread iteration — the
+        # staleness signal the default alert ruleset's serve-loop-stale
+        # rule (and a fleet router's /healthz poll) watches; a wedged
+        # decode step or a dead engine thread stops it moving
+        self._m_loop_heartbeat = reg.gauge(
+            "consensusml_serve_loop_heartbeat_seconds",
+            "unix time of the engine loop's latest iteration (liveness; "
+            "staleness means the serving thread is wedged or dead)",
+        )
+        self._m_loop_heartbeat.set(time.time())
         if self.spec is not None:
             self._m_spec_rounds = reg.counter(
                 "consensusml_spec_rounds_total",
@@ -865,6 +875,7 @@ class Engine:
         q = self._queue
         try:
             while not self._stop.is_set():
+                self._m_loop_heartbeat.set(time.time())
                 self._maybe_swap()  # flip a staged generation between steps
                 if self._sched is not None:
                     self._sched.start_tick()

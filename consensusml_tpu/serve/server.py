@@ -57,8 +57,19 @@ class ServeServer:
     ``metrics_port`` (``None`` = off, ``0`` = auto) additionally serves
     the live observability endpoints — ``/metrics`` Prometheus text,
     ``/traces`` merged Chrome trace, ``/requests`` request-trace
-    snapshot — from :class:`consensusml_tpu.obs.MetricsServer`; read the
-    bound address back from :attr:`metrics_address`.
+    snapshot, ``/alerts`` + ``/query`` + ``/healthz`` from the alert
+    plane — from :class:`consensusml_tpu.obs.MetricsServer`; read the
+    bound address back from :attr:`metrics_address`. A serving process
+    has no train loop to drive telemetry ticks, so the metrics server's
+    ``obs-ticker`` thread records metric history and evaluates the
+    alert ruleset every ``obs_tick_s`` seconds (the default ruleset
+    unless ``alert_rules`` overrides it); ``/healthz`` is the readiness
+    probe a fleet router polls (docs/observability.md
+    "Alerting & history"). The 2 s default tick keeps the history
+    ring's retention (``keep`` samples x tick) LONGER than the default
+    ruleset's 300 s slow burn window — lower ticks need a bigger
+    ``MetricsHistory(keep=)`` or the slow window silently truncates to
+    the ring span and loses its single-blip-suppression property.
     """
 
     def __init__(
@@ -67,6 +78,8 @@ class ServeServer:
         host: str = "127.0.0.1",
         port: int = 0,
         metrics_port: int | None = None,
+        obs_tick_s: float = 2.0,
+        alert_rules: Any | None = None,
     ):
         self.engine = engine
         self.metrics = None
@@ -78,10 +91,23 @@ class ServeServer:
         self._sock.bind((host, port))
         self._sock.listen(128)
         if metrics_port is not None:
-            from consensusml_tpu.obs import MetricsServer
+            from consensusml_tpu.obs import (
+                MetricsServer,
+                get_alert_engine,
+                get_history,
+            )
 
+            alerts = get_alert_engine()
+            if alert_rules is not None:
+                alerts.replace_rules(list(alert_rules))
             try:
-                self.metrics = MetricsServer(port=metrics_port, host=host)
+                self.metrics = MetricsServer(
+                    port=metrics_port,
+                    host=host,
+                    history=get_history(),
+                    alerts=alerts,
+                    tick_s=obs_tick_s,
+                )
             except OSError:
                 self._sock.close()
                 raise

@@ -35,6 +35,10 @@ def test_default_spec_is_well_formed():
     keys = {e["key"] for e in mod.DEFAULT_SPEC}
     assert "observability.link_probe_overhead_pct" in keys
     assert "observability.request_tracing_overhead_pct" in keys
+    # the alerting & history plane (ISSUE 15): amortized tick budget
+    # plus the zero-false-firing gate on the default ruleset
+    assert "observability.alerting_overhead_pct" in keys
+    assert "observability.alerts_fired_on_healthy_run" in keys
     # the cost-attribution plane (ISSUE 11): run-time overhead budget,
     # per-executable compile budgets, and the every-workload
     # expected-vs-measured presence gate
@@ -165,8 +169,12 @@ def test_regression_and_budget_violations_exit_nonzero(tmp_path, capsys):
             "value": 1000.0,  # ~60% below the trajectory's 2554
             "vs_baseline": 0.4,
         },
-        # blown absolute budget (docs promise <1%)
-        "observability": {"request_tracing_overhead_pct": 2.5},
+        # blown absolute budgets (docs promise <1% / zero false firing)
+        "observability": {
+            "request_tracing_overhead_pct": 2.5,
+            "alerting_overhead_pct": 1.8,
+            "alerts_fired_on_healthy_run": 1,
+        },
     }
     path = tmp_path / "fresh.json"
     path.write_text(json.dumps(fresh))
@@ -176,6 +184,8 @@ def test_regression_and_budget_violations_exit_nonzero(tmp_path, capsys):
     failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
     assert "value" in failed
     assert "observability.request_tracing_overhead_pct" in failed
+    assert "observability.alerting_overhead_pct" in failed
+    assert "observability.alerts_fired_on_healthy_run" in failed
     assert doc["counts"]["regressions"] >= 3
 
 

@@ -326,6 +326,47 @@ def test_obs_report_tool_empty_dir_errors(tmp_path, capsys):
     assert "no obs-" in capsys.readouterr().err
 
 
+def test_partial_snapshots_render_with_absent_blocks(tmp_path, capsys):
+    """The degraded-cluster fixture (ISSUE 15): rank files missing every
+    optional section — no metrics, no serving traces, no links, no
+    swarm events, no alert plane, even a null metrics map — must render
+    a full report with those blocks marked absent, never crash."""
+    # bare-minimum identity-only snapshot (a writer that died right
+    # after its first write)
+    (tmp_path / "obs-rank-00000.json").write_text(
+        json.dumps({"rank": 0, "role": "rank", "heartbeat_s": time.time()})
+    )
+    # a snapshot with round progress but a NULL metrics map and no
+    # heartbeat at all
+    (tmp_path / "obs-rank-00001.json").write_text(
+        json.dumps({"rank": 1, "role": "rank", "round": 3, "metrics": None})
+    )
+    doc = aggregate(str(tmp_path))
+    assert doc["skew"]["ranks"] == 2
+    assert doc["alerts"] is None and doc["history"] is None
+    mod = _tool("obs_report")
+    rc = mod.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for block in (
+        "alerts: absent",
+        "links: absent",
+        "request traces: absent",
+        "round timeline: absent",
+        "membership: absent",
+        "history: absent",
+    ):
+        assert block in out, f"missing absent marker: {block!r}\n{out}"
+    # and a MIXED directory — one partial file next to one full rank —
+    # still renders the full rank's sections
+    _write_rank(tmp_path, 2, rounds=5, lat_s=0.1, slow_edge=(1, 0))
+    rc = mod.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "links (slowest first" in out
+    assert "alerts: absent" in out  # still no alert plane anywhere
+
+
 def test_flight_recorder_dumps_are_indexed(tmp_path):
     from consensusml_tpu.obs import FlightRecorder
 
@@ -346,20 +387,27 @@ def test_flight_recorder_dumps_are_indexed(tmp_path):
 
 def test_loadgen_metrics_merge_with_rank_snapshots(tmp_path):
     lg = _tool("loadgen")
-    from consensusml_tpu.obs import get_registry
+    from consensusml_tpu.obs import MetricsHistory, get_registry
 
     def submit(ids, max_new, ctx, sampling=None):
+        time.sleep(0.02)  # give the history sampler ticks to land on
         return {"ttft_s": 0.01, "latency_s": 0.05, "tokens": [1] * max_new}
 
+    reg = get_registry()
+    history = MetricsHistory(reg, keep=64)
     report = lg.run_loadgen(
         submit, n_requests=4, rate_rps=200.0, prompt_lens=(4, 8),
         vocab=64, max_new_tokens=2,
+        history=history, history_tick_s=0.01,
     )
     assert report["completed"] == 4
-    reg = get_registry()
     assert reg.histogram("consensusml_loadgen_ttft_seconds").count >= 4
+    # the sampler thread recorded the client rings DURING the run
+    assert "consensusml_loadgen_ttft_seconds" in history.keys()
+    assert len(history.last("consensusml_loadgen_ttft_seconds", 1000)) >= 2
     ClusterWriter(
-        str(tmp_path), rank=0, role="loadgen", registry=reg
+        str(tmp_path), rank=0, role="loadgen", registry=reg,
+        history=history,
     ).write(extra={"report": report})
     _write_rank(tmp_path, 0, rounds=3, lat_s=0.1)
     doc = aggregate(str(tmp_path))
@@ -369,6 +417,11 @@ def test_loadgen_metrics_merge_with_rank_snapshots(tmp_path):
     assert ttft["count"] >= 4 and math.isfinite(ttft["p99"])
     # the rank rows are unaffected by the client snapshot
     assert len(doc["ranks"]) == 1
+    # and the client-side history digest rides the merge: the TTFT
+    # sparkline row the report joins against the server side
+    assert doc["history"] is not None
+    series = {r["series"] for r in doc["history"]["series"]}
+    assert "consensusml_loadgen_ttft_seconds" in series
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,15 @@ DEFAULT_SPEC = [
      "bound": 1.0},
     {"key": "observability.request_tracing_overhead_pct",
      "direction": "max", "bound": 1.0},
+    # alerting & history plane (ISSUE 15, docs/observability.md
+    # "Alerting & history"): the amortized history-record + default-
+    # ruleset evaluation tick stays under 1% of a gossip round, and the
+    # default ruleset fires ZERO alerts on a healthy bench run — a
+    # posture that pages on a healthy fleet is a broken posture
+    {"key": "observability.alerting_overhead_pct", "direction": "max",
+     "bound": 1.0},
+    {"key": "observability.alerts_fired_on_healthy_run",
+     "direction": "max", "bound": 0.0},
     # cost-attribution plane (docs/observability.md "Cost attribution"):
     # the run-time side must stay under 1% of a round, the ledger's
     # per-executable compile budgets are ABSOLUTE walls (CPU-tier tiny

@@ -19,7 +19,10 @@ engine's own SLO stats, as one ``LOADGEN`` JSON line.
 a ``consensusml_loadgen_*`` metrics snapshot (``obs-loadgen-<seed>.json``,
 the same registry format every rank writes under ``--obs-cluster-dir``),
 so the serving CLIENT side and the engine's ``consensusml_serve_*``
-SERVER side merge into one ``tools/obs_report.py`` report.
+SERVER side merge into one ``tools/obs_report.py`` report — including
+the client-side HISTORY rings (sampled during the run by the
+``loadgen-history`` thread), so the report's client-vs-server TTFT
+sparklines join on the same wall-clock windows.
 
     # in-process: load the artifact and serve it right here
     python tools/loadgen.py --artifact /tmp/art --rate 50 --requests 200
@@ -73,6 +76,8 @@ def run_loadgen(
     swap_fn=None,
     temperature: float = 0.0,
     top_p: float = 1.0,
+    history=None,
+    history_tick_s: float = 0.25,
 ) -> dict:
     """Open-loop driver over any ``submit(ids, max_new, ctx, sampling)
     -> result_dict`` callable (``result_dict``: ``ttft_s``,
@@ -91,11 +96,21 @@ def run_loadgen(
     Per-request seeds derive deterministically from ``(seed, arrival
     index)`` — like the trace ids — so a fixture replays to the SAME
     sampled token streams end to end (the engine's ``(seed, position)``
-    fold keys make the stream a pure function of the request)."""
+    fold keys make the stream a pure function of the request).
+
+    With ``history`` (a :class:`~consensusml_tpu.obs.MetricsHistory`
+    over this process's registry), the ``loadgen-history`` sampler
+    thread (docs/threads.md) records the client-side rings every
+    ``history_tick_s`` during the run — client SLO observations stream
+    per COMPLETION into the registry (not post-hoc), so the rings carry
+    the client-observed TTFT trend on the same wall-clock windows the
+    server side records, and ``tools/obs_report.py`` can render
+    client-vs-server sparklines joined in time."""
     from consensusml_tpu.obs import TraceContext
 
     rng = np.random.default_rng(seed)
     lo, hi = prompt_lens
+    metrics = _LoadgenMetrics(rate_rps)
     results: list[dict] = []
     errors: list[str] = []
     lock = threading.Lock()
@@ -107,11 +122,26 @@ def run_loadgen(
             r = submit(ids, max_new_tokens, ctx, sampling)
             r.setdefault("trace_id", ctx.trace_id)
             r.setdefault("request_id", ctx.request_id)
+            metrics.observe_result(r)
             with lock:
                 results.append(r)
         except Exception as e:
+            metrics.observe_error()
             with lock:
                 errors.append(f"{type(e).__name__}: {e}")
+
+    sampler = None
+    sampler_stop = threading.Event()
+    if history is not None:
+
+        def sample_loop():
+            while not sampler_stop.wait(history_tick_s):
+                history.record()
+
+        sampler = threading.Thread(
+            target=sample_loop, name="loadgen-history", daemon=True
+        )
+        sampler.start()
 
     t_start = time.perf_counter()
     for i in range(n_requests):
@@ -134,18 +164,24 @@ def run_loadgen(
             target=one, args=(list(map(int, ids)), ctx, sampling)
         )
         threads.append(t)
+        metrics.observe_issued()
         t.start()
         # exponential inter-arrival gap == Poisson arrivals
         time.sleep(float(rng.exponential(1.0 / rate_rps)))
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
+    if sampler is not None:
+        sampler_stop.set()
+        sampler.join(timeout=max(2.0, 4 * history_tick_s))
 
     pct = lambda key, q: (
         float(np.percentile([r[key] for r in results], q)) if results else float("nan")
     )
     tokens_out = int(sum(len(r["tokens"]) for r in results))
-    _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall)
+    metrics.finalize(len(results), tokens_out, wall)
+    if history is not None:
+        history.record()  # final point carries the end-of-run gauges
     # the client-observed worst tail, with identity: each row's
     # trace_id/request_id resolves to a server-side RequestTrace
     slowest = sorted(results, key=lambda r: -r["latency_s"])[:8]
@@ -183,51 +219,70 @@ def run_loadgen(
     }
 
 
-def _record_metrics(results, errors, n_requests, rate_rps, tokens_out, wall):
-    """Feed the run into the process registry as the
-    ``consensusml_loadgen_*`` family — the client-observed half of the
-    serving SLO story, in the same registry/snapshot format the server
-    side exports (docs/observability.md)."""
-    from consensusml_tpu.obs import get_registry
+class _LoadgenMetrics:
+    """The ``consensusml_loadgen_*`` families — the client-observed half
+    of the serving SLO story, in the same registry/snapshot format the
+    server side exports (docs/observability.md). Observations STREAM in
+    per completion (from the per-arrival threads; every metric carries
+    its own lock) so the history sampler sees the TTFT/latency
+    distributions move during the run, not one post-hoc dump."""
 
-    from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+    def __init__(self, rate_rps: float):
+        from consensusml_tpu.obs import get_registry
+        from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
 
-    reg = get_registry()
-    ttft = reg.histogram(
-        "consensusml_loadgen_ttft_seconds",
-        "client-observed time to first token", buckets=DEFAULT_SLO_BUCKETS,
-    )
-    lat = reg.histogram(
-        "consensusml_loadgen_latency_seconds",
-        "client-observed end-to-end request latency",
-        buckets=DEFAULT_SLO_BUCKETS,
-    )
-    for r in results:
+        reg = get_registry()
+        self.ttft = reg.histogram(
+            "consensusml_loadgen_ttft_seconds",
+            "client-observed time to first token",
+            buckets=DEFAULT_SLO_BUCKETS,
+        )
+        self.lat = reg.histogram(
+            "consensusml_loadgen_latency_seconds",
+            "client-observed end-to-end request latency",
+            buckets=DEFAULT_SLO_BUCKETS,
+        )
+        self.requests = reg.counter(
+            "consensusml_loadgen_requests_total", "requests issued"
+        )
+        self.completed = reg.counter(
+            "consensusml_loadgen_completed_total", "requests completed"
+        )
+        self.errors = reg.counter(
+            "consensusml_loadgen_errors_total", "requests that errored"
+        )
+        self.tokens = reg.counter(
+            "consensusml_loadgen_tokens_total", "tokens received"
+        )
+        reg.gauge(
+            "consensusml_loadgen_offered_rate_rps", "Poisson arrival rate"
+        ).set(rate_rps)
+        self.achieved = reg.gauge(
+            "consensusml_loadgen_achieved_rps", "completions per wall second"
+        )
+        self.goodput = reg.gauge(
+            "consensusml_loadgen_tokens_per_sec", "token goodput"
+        )
+
+    def observe_issued(self) -> None:
+        # at ARRIVAL, not completion: the live requests-vs-completed gap
+        # is the queue-buildup signal the history rings exist to show
+        self.requests.inc()
+
+    def observe_result(self, r: dict) -> None:
         # exemplar-bearing: the worst buckets remember WHICH request
         rid = r.get("request_id") or None
-        ttft.observe(r["ttft_s"], exemplar=rid)
-        lat.observe(r["latency_s"], exemplar=rid)
-    reg.counter(
-        "consensusml_loadgen_requests_total", "requests issued"
-    ).inc(n_requests)
-    reg.counter(
-        "consensusml_loadgen_completed_total", "requests completed"
-    ).inc(len(results))
-    reg.counter(
-        "consensusml_loadgen_errors_total", "requests that errored"
-    ).inc(len(errors))
-    reg.counter(
-        "consensusml_loadgen_tokens_total", "tokens received"
-    ).inc(tokens_out)
-    reg.gauge(
-        "consensusml_loadgen_offered_rate_rps", "Poisson arrival rate"
-    ).set(rate_rps)
-    reg.gauge(
-        "consensusml_loadgen_achieved_rps", "completions per wall second"
-    ).set(len(results) / wall if wall > 0 else 0.0)
-    reg.gauge(
-        "consensusml_loadgen_tokens_per_sec", "token goodput"
-    ).set(tokens_out / wall if wall > 0 else 0.0)
+        self.ttft.observe(r["ttft_s"], exemplar=rid)
+        self.lat.observe(r["latency_s"], exemplar=rid)
+        self.completed.inc()
+        self.tokens.inc(len(r["tokens"]))
+
+    def observe_error(self) -> None:
+        self.errors.inc()
+
+    def finalize(self, completed: int, tokens_out: int, wall: float) -> None:
+        self.achieved.set(completed / wall if wall > 0 else 0.0)
+        self.goodput.set(tokens_out / wall if wall > 0 else 0.0)
 
 
 def _engine_submit(engine):
@@ -332,9 +387,11 @@ def main(argv=None) -> int:
     p.add_argument("--obs-snapshot", default=None, metavar="DIR",
                    help="write the consensusml_loadgen_* metrics snapshot "
                         "to DIR (obs-loadgen-<seed>.json, cluster snapshot "
-                        "format) — point it at the serving side's "
-                        "--obs-cluster-dir and tools/obs_report.py shows "
-                        "client + server SLOs in one report")
+                        "format), including the client-side history rings "
+                        "sampled during the run — point it at the serving "
+                        "side's --obs-cluster-dir and tools/obs_report.py "
+                        "shows client + server SLOs (and joined TTFT "
+                        "sparklines) in one report")
     args = p.parse_args(argv)
 
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
@@ -365,6 +422,16 @@ def main(argv=None) -> int:
         vocab = 64  # socket mode cannot introspect the model; ids stay tiny
         submit = _socket_submit(host, int(port))
 
+    history = None
+    if args.obs_snapshot:
+        # client-side history rings: the sampler thread records the
+        # loadgen families at cadence DURING the run, so the snapshot's
+        # digest carries the client TTFT trend on the same wall-clock
+        # windows as the server's — obs_report renders them as adjacent
+        # sparklines
+        from consensusml_tpu.obs import get_history
+
+        history = get_history()
     report = run_loadgen(
         submit,
         n_requests=args.requests,
@@ -378,6 +445,7 @@ def main(argv=None) -> int:
         swap_fn=swap_fn,
         temperature=args.temperature,
         top_p=args.top_p,
+        history=history,
     )
     if engine is not None:
         report["engine"] = engine.stats()
@@ -390,7 +458,8 @@ def main(argv=None) -> int:
         # exemplar request_ids resolve against; socket mode leaves it to
         # the server's own snapshot
         path = ClusterWriter(
-            args.obs_snapshot, rank=args.seed, role="loadgen"
+            args.obs_snapshot, rank=args.seed, role="loadgen",
+            history=history,
         ).write(
             extra={
                 "report": report,

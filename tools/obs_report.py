@@ -9,10 +9,15 @@ detection, churn counters, the swarm membership timeline
 (join/drop/straggler events vs round, with each join's gossip-bootstrap
 cost and epsilon), the SLOWEST-REQUEST table (SLO histogram exemplars
 resolved against the merged request-trace index — client and server
-sides of one request join on trace_id), and the cross-rank ROUND
+sides of one request join on trace_id), the cross-rank ROUND
 TIMELINE attributing straggler rounds to phase (feed vs gossip vs
-compute). See docs/observability.md "Cluster view" / "Request tracing"
-and docs/elasticity.md.
+compute), the fleet-wide ALERTS table (firing alerts deduped by
+rule+series, worst-first, from each snapshot's alert-plane state), and
+per-series history SPARKLINES (client and server TTFT side by side).
+Partial snapshots degrade gracefully: a rank file missing an optional
+section renders with that block marked absent, never a crash. See
+docs/observability.md "Cluster view" / "Request tracing" /
+"Alerting & history" and docs/elasticity.md.
 
     python tools/obs_report.py /shared/obs            # text report
     python tools/obs_report.py /shared/obs --json     # full JSON doc
@@ -63,33 +68,165 @@ def _fmt_count(v) -> str:
     return f"{v:.0f}"
 
 
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(points) -> str:
+    """Unicode sparkline over history-digest points ([t, v] rows; None
+    values — an interval that saw nothing — render as '.')."""
+    vals = [
+        v
+        for _t, v in points
+        if isinstance(v, (int, float)) and math.isfinite(v)
+    ]
+    if not vals:
+        return "." * min(len(points), 8)
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for _t, v in points:
+        if not isinstance(v, (int, float)) or not math.isfinite(v):
+            out.append(".")
+            continue
+        i = int((v - lo) / span * (len(_SPARK_GLYPHS) - 1))
+        out.append(_SPARK_GLYPHS[max(0, min(i, len(_SPARK_GLYPHS) - 1))])
+    return "".join(out)
+
+
+# history series surfaced first in the sparkline block: the client/
+# server SLO joins and the pressure signals the alert rules watch
+_SPARK_PRIORITY = (
+    "consensusml_serve_ttft_seconds",
+    "consensusml_loadgen_ttft_seconds",
+    "consensusml_serve_intertoken_seconds",
+    "consensusml_loadgen_latency_seconds",
+    "consensusml_serve_queue_depth",
+    "consensusml_pool_blocks_free",
+    "consensusml_round_latency_seconds",
+    "consensusml_consensus_distance",
+    "consensusml_health_decay_measured",
+)
+
+
+def _render_alerts(doc: dict, add) -> None:
+    """The Alerts table: fleet-wide firing alerts (deduped by
+    rule+series, worst-first) and recent plane events; marked absent
+    when no snapshot exported the alert plane."""
+    al = doc.get("alerts")
+    if not al:
+        add("alerts: absent (no snapshot carries an alert plane)")
+        return
+    if not al.get("firing"):
+        add(
+            f"alerts: none firing ({al.get('ranks_reporting', 0)} "
+            f"snapshot(s) reporting, "
+            f"{al.get('resolved_recent_total', 0)} recently resolved)"
+        )
+    else:
+        add(
+            f"alerts: {al.get('firing_total', 0)} FIRING "
+            f"({al.get('ranks_reporting', 0)} snapshot(s) reporting):"
+        )
+        add("  sev   rule                        value      for      reporters  series")
+        now = doc.get("time_s")
+        for a in al["firing"]:
+            dur = (
+                f"{now - a['fired_s']:.0f}s"
+                if now and a.get("fired_s")
+                else "-"
+            )
+            v = a.get("value")
+            add(
+                f"  {str(a.get('severity')):<5} {str(a.get('rule')):<27} "
+                f"{'-' if v is None else format(v, '.4g'):>9}  {dur:>7}  "
+                f"{','.join(a.get('reporters') or []):<9}  "
+                f"{a.get('series')}"
+            )
+    for ev in (al.get("events_recent") or [])[-4:]:
+        add(
+            f"  event [{ev.get('severity')}] {ev.get('source')} "
+            f"({ev.get('reporter')}): {ev.get('message')}"
+        )
+
+
+def _render_history(doc: dict, add, top: int = 16) -> None:
+    """Per-series sparkline summaries from the merged history digests;
+    SLO/pressure families first so client-vs-server TTFT reads as two
+    adjacent rows."""
+    hist = doc.get("history")
+    if not hist:
+        add("history: absent (no snapshot carries history rings)")
+        return
+    rows = hist.get("series") or []
+    prio = {name: i for i, name in enumerate(_SPARK_PRIORITY)}
+
+    def fam(row):
+        return str(row.get("series", "")).partition("{")[0]
+
+    def rank_of(row):
+        f = fam(row)
+        if f in prio:
+            return prio[f]
+        # the plane's own meta-families last — real signals first
+        if f.startswith(("consensusml_history_", "consensusml_alert")):
+            return len(prio) + 1
+        return len(prio)
+
+    rows = sorted(
+        rows,
+        key=lambda r: (
+            rank_of(r),
+            str(r.get("series")),
+            str(r.get("role") or ""),
+            r.get("rank") or 0,
+        ),
+    )
+    shown = rows[:top] if top else rows
+    add(
+        f"history ({hist.get('series_total', len(rows))} series from "
+        f"{hist.get('ranks_reporting', 0)} snapshot(s)"
+        + (f", top {len(shown)}" if len(shown) < len(rows) else "")
+        + "; gauges raw, counters rate/s, histograms interval-p99):"
+    )
+    for r in shown:
+        who = f"{r.get('role') or 'rank'}-{r.get('rank')}"
+        add(
+            f"  {str(r.get('series'))[:46]:<46} {who:<10} "
+            f"{_spark(r.get('points') or []):<32} "
+            f"last={'-' if r.get('last') is None else format(r['last'], '.4g')}"
+        )
+
+
 def render_text(doc: dict) -> str:
     lines: list[str] = []
     add = lines.append
-    skew = doc["skew"]
-    add(f"cluster report: {doc['cluster_dir']}")
+    skew = doc.get("skew") or {}
+    add(f"cluster report: {doc.get('cluster_dir')}")
+    skew_v = skew.get("round_latency_skew")
     add(
-        f"ranks={skew['ranks']} rounds [{skew['round_min']}, "
-        f"{skew['round_max']}] lag={skew['round_lag']} "
-        f"latency skew={skew['round_latency_skew'] and round(skew['round_latency_skew'], 3)}"
+        f"ranks={skew.get('ranks')} rounds [{skew.get('round_min')}, "
+        f"{skew.get('round_max')}] lag={skew.get('round_lag')} "
+        f"latency skew={skew_v and round(skew_v, 3)}"
     )
+    _render_alerts(doc, add)
     add("")
     add("rank  round  age      lat(mean/p99)        consensus  decay(meas/bound)  viol")
-    for r in doc["ranks"]:
-        lat = r["round_latency"]
-        h = r["health"]
+    for r in doc.get("ranks") or []:
+        lat = r.get("round_latency")
+        h = r.get("health") or {}
+        age = r.get("heartbeat_age_s")
         add(
-            f"{r['rank']:>4}  {str(r['round']):>5}  "
-            f"{r['heartbeat_age_s']:>6.1f}s  "
+            f"{_int_or_dash(r.get('rank')):>4}  {str(r.get('round')):>5}  "
+            f"{'-' if age is None else format(age, '.1f'):>6}s  "
             f"{_fmt_s(lat and lat['mean']):>9}/{_fmt_s(lat and lat['p99']):<9}  "
-            f"{'-' if r['consensus_distance'] is None else format(r['consensus_distance'], '.4g'):>9}  "
-            f"{'-' if h['decay_measured'] is None else format(h['decay_measured'], '.4f'):>8}/"
-            f"{'-' if h['decay_bound'] is None else format(h['decay_bound'], '.4f'):<8}  "
-            f"{int(h['bound_violation'] or 0)}"
+            f"{'-' if r.get('consensus_distance') is None else format(r['consensus_distance'], '.4g'):>9}  "
+            f"{'-' if h.get('decay_measured') is None else format(h['decay_measured'], '.4f'):>8}/"
+            f"{'-' if h.get('decay_bound') is None else format(h['decay_bound'], '.4f'):<8}  "
+            f"{int(h.get('bound_violation') or 0)}"
         )
-    if doc["links"]:
+    if doc.get("links"):
         add("")
-        add(f"links (slowest first; {doc['links_total']} total):")
+        add(f"links (slowest first; {doc.get('links_total')} total):")
         add("  src->dst   probes  mean       p99        bytes/round")
         for l in doc["links"]:
             add(
@@ -98,31 +235,35 @@ def render_text(doc: dict) -> str:
                 f"{_fmt_s(l['p99_latency_s']):>9}  "
                 f"{_fmt_b(l['wire_bytes_per_round']):>10}"
             )
-    h = doc["health"]
+    else:
+        add("links: absent (no rank exported link families)")
+    h = doc.get("health") or {}
     add("")
     add(
-        f"health: bound={h['decay_bound']} worst measured="
-        f"{h['decay_measured_worst']} ranks_in_violation="
-        f"{h['ranks_in_violation']} anomalies={h['anomalies_total']}"
+        f"health: bound={h.get('decay_bound')} worst measured="
+        f"{h.get('decay_measured_worst')} ranks_in_violation="
+        f"{h.get('ranks_in_violation')} anomalies={h.get('anomalies_total')}"
     )
-    if doc["stragglers"]:
+    if doc.get("stragglers"):
         add("stragglers:")
         for s in doc["stragglers"]:
             add(f"  rank {s['rank']}: {'; '.join(s['reasons'])}")
     else:
         add("stragglers: none")
-    c = doc["churn"]
+    c = doc.get("churn") or {}
     add(
-        f"churn: resizes={c['elastic_resizes_total']:.0f} "
-        f"joins={c['joined_workers_total']:.0f} "
-        f"fault_rounds={c['fault_rounds_total']:.0f} "
-        f"drops={c['worker_drops_total']:.2f} "
-        f"watchdog_timeouts={c['watchdog_timeouts_total']:.0f} "
+        f"churn: resizes={c.get('elastic_resizes_total', 0):.0f} "
+        f"joins={c.get('joined_workers_total', 0):.0f} "
+        f"fault_rounds={c.get('fault_rounds_total', 0):.0f} "
+        f"drops={c.get('worker_drops_total', 0):.2f} "
+        f"watchdog_timeouts={c.get('watchdog_timeouts_total', 0):.0f} "
         f"gossip_bootstraps={c.get('bootstrapped_joiners_total', 0):.0f} "
         f"recovery_rounds={c.get('recovery_rounds_total', 0):.0f}"
     )
     mem = doc.get("membership") or {}
-    if mem.get("timeline") or mem.get("event_counts"):
+    if not (mem.get("timeline") or mem.get("event_counts")):
+        add("membership: absent (no swarm events in snapshots)")
+    else:
         counts = mem.get("event_counts") or {}
         add(
             f"membership: epoch={_int_or_dash(mem.get('epoch'))} "
@@ -140,19 +281,23 @@ def render_text(doc: dict) -> str:
                 detail = row.get("detail") or {}
                 extra = ""
                 if "bootstrap_rounds" in detail:
+                    eps = detail.get("eps_measured")
                     extra = (
-                        f"  [bootstrap {detail['bootstrap_rounds']} rounds, "
-                        f"eps {detail['eps_measured']:.2e}]"
+                        f"  [bootstrap {detail['bootstrap_rounds']} rounds"
+                        + (f", eps {eps:.2e}" if eps is not None else "")
+                        + "]"
                     )
                 elif "duration" in detail:
                     extra = f"  [{detail['duration']} rounds]"
                 add(
-                    f"  {row.get('round'):>5} : "
+                    f"  {_int_or_dash(row.get('round')):>5} : "
                     f"{glyph.get(row.get('kind'), '?')} "
-                    f"{row.get('kind'):<8} {ws}{extra}"
+                    f"{str(row.get('kind')):<8} {ws}{extra}"
                 )
     req = doc.get("requests") or {}
-    if req.get("traces_indexed") or req.get("slowest"):
+    if not (req.get("traces_indexed") or req.get("slowest")):
+        add("request traces: absent (no serving sections in snapshots)")
+    else:
         add("")
         add(
             f"request traces: {req.get('traces_indexed', 0)} indexed "
@@ -184,7 +329,9 @@ def render_text(doc: dict) -> str:
                     f"{str(r.get('request_id')):<20}  {detail}"
                 )
     timeline = doc.get("round_timeline") or []
-    if timeline:
+    if not timeline:
+        add("round timeline: absent (no span digests in snapshots)")
+    else:
         add("")
         add("round timeline (cross-rank, straggler time by phase):")
         for row in timeline:
@@ -237,13 +384,15 @@ def render_text(doc: dict) -> str:
                 else ""
             )
         )
-    if doc["flight_recorders"]:
+    add("")
+    _render_history(doc, add)
+    if doc.get("flight_recorders"):
         add("flight recorders:")
         for fr in doc["flight_recorders"]:
             add(f"  {fr['file']} ({fr['bytes']}B)")
-    for cl in doc["clients"]:
-        add(f"client [{cl['role']}-{cl['rank']}]:")
-        for k, v in sorted(cl["metrics"].items()):
+    for cl in doc.get("clients") or []:
+        add(f"client [{cl.get('role')}-{cl.get('rank')}]:")
+        for k, v in sorted((cl.get("metrics") or {}).items()):
             if isinstance(v, dict):
                 add(
                     f"  {k}: mean={_fmt_s(v['mean'])} p50={_fmt_s(v['p50'])} "
@@ -251,7 +400,7 @@ def render_text(doc: dict) -> str:
                 )
             else:
                 add(f"  {k}: {v:g}")
-    for e in doc["errors"]:
+    for e in doc.get("errors") or []:
         add(f"unreadable snapshot: {e['_file']}: {e['_error']}")
     return "\n".join(lines)
 

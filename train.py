@@ -195,7 +195,10 @@ def parse_args(argv=None):
                         "scrape (same locked expose() path as "
                         "--metrics-prom), /traces the merged Chrome trace, "
                         "/requests the request-trace registry snapshot "
-                        "(docs/observability.md 'Request tracing'), and "
+                        "(docs/observability.md 'Request tracing'), "
+                        "/alerts + /query + /healthz the SLO/alert plane "
+                        "over the in-process metric history "
+                        "(docs/observability.md 'Alerting & history'), and "
                         "/profile?ms=N an on-demand jax.profiler capture of "
                         "the LIVE loop (single-flight; docs/perf.md)")
     p.add_argument("--cost-ledger", action="store_true",
@@ -211,7 +214,8 @@ def parse_args(argv=None):
                         "0 — analysis only, jit caches untouched)")
     p.add_argument("--telemetry-every", type=int, default=10, metavar="N",
                    help="cadence (rounds) for the heavier telemetry: metric "
-                        "snapshots, Prometheus rewrite, and the CHOCO "
+                        "snapshots, Prometheus rewrite, the history-ring "
+                        "sample + SLO/alert rule evaluation, and the CHOCO "
                         "||s - xhat|| residual fetch (default 10)")
     p.add_argument("--flight-recorder", default=None, metavar="DIR",
                    help="enable the crash flight recorder: on watchdog "
@@ -796,12 +800,23 @@ def main(argv=None) -> int:
         tracer.enabled = True
     metrics_http = None
     if args.metrics_port is not None:
-        from consensusml_tpu.obs import MetricsServer
+        from consensusml_tpu.obs import (
+            MetricsServer,
+            get_alert_engine,
+            get_history,
+        )
 
-        metrics_http = MetricsServer(port=args.metrics_port)
+        # the round loop drives record()/evaluate() from its telemetry
+        # tick (no ticker thread here) — the server only surfaces
+        # /alerts, /query and /healthz over the same engines
+        metrics_http = MetricsServer(
+            port=args.metrics_port,
+            history=get_history(),
+            alerts=get_alert_engine(),
+        )
         print(
             f"metrics endpoint: {metrics_http.url()} "
-            "(/metrics /traces /requests)",
+            "(/metrics /traces /requests /alerts /query /healthz)",
             flush=True,
         )
     for k, v in engine.telemetry(param_shapes).items():
@@ -1047,6 +1062,19 @@ def _churn_loop(args, bundle, scale) -> int:
 
     if args.trace_events or args.metrics_prom or args.obs_cluster_dir:
         get_tracer().enabled = True
+    history = alerts = None
+    # same arming condition as main's telemetry_on: --metrics-port alone
+    # must still drive record()/evaluate() or its /alerts endpoint would
+    # advertise a plane no tick ever feeds
+    if (
+        args.trace_events or args.metrics_prom or args.obs_cluster_dir
+        or args.flight_recorder or args.link_probes or args.cost_ledger
+        or args.metrics_port is not None
+    ):
+        from consensusml_tpu.obs import get_alert_engine, get_history
+
+        history = get_history()
+        alerts = get_alert_engine()
     cluster = None
     if args.obs_cluster_dir:
         cluster = ClusterWriter(
@@ -1054,6 +1082,8 @@ def _churn_loop(args, bundle, scale) -> int:
             rank=jax.process_index(),
             registry=registry,
             world_size=capacity,
+            history=history,
+            alerts=alerts,
         )
         print(f"cluster snapshots: {cluster.path}", flush=True)
 
@@ -1083,6 +1113,9 @@ def _churn_loop(args, bundle, scale) -> int:
                 )
             if (rnd + 1) % max(1, args.telemetry_every) == 0:
                 registry.snapshot({"round": rnd})
+                if history is not None:
+                    history.record()
+                    alerts.evaluate()
                 if args.metrics_prom:
                     registry.write_prometheus(args.metrics_prom)
                 if cluster is not None:
@@ -1226,9 +1259,22 @@ def _train_loop(
         LinkProber,
     )
 
+    # SLO/alert plane (obs.history/obs.alerts): history rings + the
+    # default ruleset, driven from telemetry_tick below; only armed when
+    # some telemetry sink exists (the singletons then also feed cluster
+    # snapshots, /alerts and flight-recorder dumps)
+    history = alerts = None
+    if telemetry_on:
+        from consensusml_tpu.obs import get_alert_engine, get_history
+
+        history = get_history()
+        alerts = get_alert_engine()
     # always on: a few float stores per round, and sustained divergence
-    # should be loud even when no sink is configured
-    health = ConsensusHealthMonitor(engine.topology, registry=registry)
+    # should be loud even when no sink is configured; with the alert
+    # plane armed, episode logs route through its event stream
+    health = ConsensusHealthMonitor(
+        engine.topology, registry=registry, alerts=alerts
+    )
     prober = None
     if args.link_probes:
         prober = LinkProber(
@@ -1251,6 +1297,8 @@ def _train_loop(
             rank=jax.process_index(),
             registry=registry,
             world_size=bundle.world_size,
+            history=history,
+            alerts=alerts,
         )
         print(f"cluster snapshots: {cluster.path}", flush=True)
 
@@ -1309,6 +1357,13 @@ def _train_loop(
                 "CHOCO tracking residual ||s - xhat|| (sampled)",
             ).set(resid)
         registry.snapshot({"round": rnd})
+        if history is not None:
+            # sample every family into the history rings, then evaluate
+            # the SLO/alert rules over the retained windows — fire and
+            # clear transitions land on /alerts, in tracer instants and
+            # in the cluster snapshot written below
+            history.record()
+            alerts.evaluate()
         if args.metrics_prom:
             registry.write_prometheus(args.metrics_prom)
         if cluster is not None:
