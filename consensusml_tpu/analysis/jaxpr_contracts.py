@@ -750,10 +750,156 @@ def _check_spec_stage_jaxprs(name: str, bundle) -> list[Finding]:
     return findings
 
 
+def _check_fused_attention_jaxprs(name: str, bundle) -> list[Finding]:
+    """Fused paged-attention kernel-tier contracts (causal-LM configs).
+
+    The kernel tier (``models/paged_attention.py``) replaces the
+    two-step gather + dense attention in the paged decode step and the
+    spec k-verify window with ONE pallas pass per layer. The contract
+    set, per stage, traced on the exact jit the engine would run under
+    ``attn_impl="interpret"`` (same jaxpr as the compiled TPU program
+    modulo lowering):
+
+    - ``fused-active`` — the traced program contains ``pallas_call``
+      equations at all: an impl that silently composed the gather
+      reference would pass every numeric parity pin while fusing
+      nothing (the regression the tier exists to prevent). A NEGATIVE
+      fixture rides along: the gather impl of the same stage must trace
+      to ZERO ``pallas_call``s — if it doesn't, the detector can no
+      longer distinguish fused from unfused and its PASSes are vacuous;
+    - ``kernel-count`` — exactly ONE ``pallas_call`` per layer per
+      stage. More means a layer split its pass (extra HBM round-trips);
+      fewer means a layer fell back to the gather path;
+    - the shared purity contracts (no host callbacks, no f64) and the
+      step-over-step canonical-hash stability — the fused stages
+      inherit the zero-recompile contract unchanged.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.serve import decode as D
+    from consensusml_tpu.serve import pool as P
+
+    if bundle.model is None or not D.supports_decode(bundle.model):
+        return []
+    findings: list[Finding] = []
+    dm = D.DecodeModel.wrap(bundle.model)
+    layers = dm.model.config.layers
+    slots, max_len, bs, k = 4, min(dm.max_len, 32), 8, 2
+    blocks_per_slot = max_len // bs
+    num_blocks = slots * blocks_per_slot + 1
+    cols = P.spec_table_cols(blocks_per_slot, bs, k)
+    probe = jax.eval_shape(bundle.init_params, jax.random.key(0))
+    params = probe[0] if isinstance(probe, tuple) and len(probe) == 2 else probe
+    pages = jax.eval_shape(lambda: P.init_pages(dm, num_blocks, bs))
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    positions = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    samp = _sampling_structs(slots)
+
+    def _kernel_findings(mk, closed, what):
+        n = count_primitives(closed).get("pallas_call", 0)
+        if n == 0:
+            return [
+                mk(
+                    "fused-active", "two-step-fallback",
+                    f"{what} under attn_impl='interpret' traces ZERO "
+                    "pallas_calls — the kernel tier silently composed "
+                    "the gather reference instead of fusing",
+                )
+            ]
+        if n != layers:
+            return [
+                mk(
+                    "kernel-count", "pallas_call",
+                    f"{what} traces {n} pallas_call(s) but the fused "
+                    f"contract is exactly one per layer ({layers}): "
+                    "more = a layer's pass split (extra HBM "
+                    "round-trips), fewer = a layer off the kernel path",
+                )
+            ]
+        return []
+
+    # -- fused decode step -------------------------------------------------
+    mkd = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "fused_paged_decode", detail, msg
+    )
+    dec_table = jax.ShapeDtypeStruct((slots, blocks_per_slot), jnp.int32)
+    decode = P.make_paged_decode_fn(dm, attn_impl="interpret")
+    closed = jax.make_jaxpr(decode)(
+        params, pages, dec_table, tokens, positions, *samp
+    )
+    findings += _kernel_findings(mkd, closed, "the fused paged decode step")
+    findings += _callback_f64_findings(closed, mkd, "fused paged decode stage")
+    out_tokens, out_pages = jax.eval_shape(
+        decode, params, pages, dec_table, tokens, positions, *samp
+    )
+    findings += _hash_stable(
+        mkd, decode, closed,
+        (params, out_pages, dec_table, out_tokens, positions, *samp),
+        "fused paged decode", "signature-hash",
+    )
+    # negative fixture: the gather impl of the SAME stage must fuse
+    # nothing, or the fused-active detector above proves nothing
+    gather_decode = P.make_paged_decode_fn(dm, attn_impl="gather")
+    unfused = count_primitives(
+        jax.make_jaxpr(gather_decode)(
+            params, pages, dec_table, tokens, positions, *samp
+        )
+    ).get("pallas_call", 0)
+    if unfused != 0:
+        findings.append(
+            mkd(
+                "fused-active", "negative-fixture",
+                f"the GATHER decode stage traces {unfused} "
+                "pallas_call(s); the fused-active detector can no "
+                "longer tell fused from unfused apart",
+            )
+        )
+
+    # -- fused spec k-verify window ----------------------------------------
+    mkv = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "fused_spec_verify", detail, msg
+    )
+    spec_table = jax.ShapeDtypeStruct((slots, cols), jnp.int32)
+    props, q_sel, q_probs, _dp = jax.eval_shape(
+        P.make_draft_propose_fn(dm, k),
+        params, pages, spec_table, tokens, positions, *samp,
+    )
+    verify = P.make_verify_fn(dm, k, attn_impl="interpret")
+    closed = jax.make_jaxpr(verify)(
+        params, pages, spec_table, tokens, props, q_sel, q_probs,
+        positions, *samp,
+    )
+    findings += _kernel_findings(mkv, closed, "the fused spec verify window")
+    findings += _callback_f64_findings(closed, mkv, "fused spec verify stage")
+    _n, _y, v_pages = jax.eval_shape(
+        verify, params, pages, spec_table, tokens, props, q_sel, q_probs,
+        positions, *samp,
+    )
+    findings += _hash_stable(
+        mkv, verify, closed,
+        (params, v_pages, spec_table, tokens, props, q_sel, q_probs,
+         positions, *samp),
+        "fused spec verify", "signature-hash",
+    )
+    for stage, mk, out in (
+        ("decode", mkd, out_pages),
+        ("verify", mkv, v_pages),
+    ):
+        findings += _cache_drift(
+            mk, pages, out, f"the fused {stage} stage's page pytree",
+            "pages-drift",
+            "the pool is one fixed allocation for the engine's life — "
+            "donation and the jit cache both break",
+        )
+    return findings
+
+
 def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
     """All jaxpr contracts for one config (incl. the serving decode
-    step, BOTH paged serving stages, and the speculative propose/verify
-    pair on causal-LM configs)."""
+    step, BOTH paged serving stages, the speculative propose/verify
+    pair, and the fused paged-attention kernel tier on causal-LM
+    configs)."""
     from consensusml_tpu import configs
 
     bundle = configs.build(name, scale=scale)
@@ -762,6 +908,7 @@ def check_config(name: str, *, scale: str = "smoke") -> list[Finding]:
     findings.extend(_check_decode_jaxpr(name, bundle))
     findings.extend(_check_paged_stage_jaxprs(name, bundle))
     findings.extend(_check_spec_stage_jaxprs(name, bundle))
+    findings.extend(_check_fused_attention_jaxprs(name, bundle))
     return findings
 
 

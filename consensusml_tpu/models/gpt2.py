@@ -26,6 +26,10 @@ from consensusml_tpu.models.attention import (
     update_kv_cache,
 )
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
+from consensusml_tpu.models.paged_attention import (
+    fused_paged_attention,
+    fused_paged_attention_window,
+)
 
 __all__ = ["GPT2Config", "GPT2LM", "gpt2_medium", "gpt2_loss_fn"]
 
@@ -87,6 +91,7 @@ class _DecoderBlock(nn.Module):
         positions=None,
         return_kv: bool = False,
         block_table=None,
+        attn_impl: str = "gather",
     ):
         c = self.config
         d_head = c.hidden // c.heads
@@ -100,10 +105,19 @@ class _DecoderBlock(nn.Module):
                 k_pages, v_pages = paged_update_kv_cache_window(
                     cache, k, v, block_table, positions
                 )
-                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-                attn = cached_attention_window(
-                    q, kg, vg, positions=positions, dtype=c.dtype
-                )
+                if attn_impl == "gather":
+                    kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                    attn = cached_attention_window(
+                        q, kg, vg, positions=positions, dtype=c.dtype
+                    )
+                else:
+                    # kernel tier: one fused pallas pass per layer, no
+                    # gathered view in HBM (models/paged_attention.py;
+                    # bit-exact vs the gather branch per impl)
+                    attn = fused_paged_attention_window(
+                        q, k_pages, v_pages, block_table,
+                        positions=positions, dtype=c.dtype, impl=attn_impl,
+                    )
             else:
                 # paged decode step: the cache is a shared block pool;
                 # this slot's logical view assembles by block-table
@@ -111,10 +125,16 @@ class _DecoderBlock(nn.Module):
                 k_pages, v_pages, lengths = paged_update_kv_cache(
                     cache, k, v, block_table, positions
                 )
-                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-                attn = cached_attention(
-                    q, kg, vg, lengths=lengths, dtype=c.dtype
-                )
+                if attn_impl == "gather":
+                    kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                    attn = cached_attention(
+                        q, kg, vg, lengths=lengths, dtype=c.dtype
+                    )
+                else:
+                    attn = fused_paged_attention(
+                        q, k_pages, v_pages, block_table,
+                        lengths=lengths, dtype=c.dtype, impl=attn_impl,
+                    )
             new_cache = {"k": k_pages, "v": v_pages}
         elif cache is not None:
             # decode step: write this token's K/V into the slot cache and
@@ -154,11 +174,18 @@ class GPT2LM(nn.Module):
         kv_cache: list | None = None,
         return_kv: bool = False,
         block_table: jax.Array | None = None,
+        attn_impl: str = "gather",
     ):
         """Logits (f32) by default; ``return_hidden=True`` returns the
         pre-head states (post final-LN, model dtype) instead — the
         chunked-vocab loss path computes the head inside the loss so the
         full logits tensor is never materialized.
+
+        ``attn_impl`` selects the paged-attention tier ("gather" = the
+        two-step reference, "jnp"/"interpret"/"pallas" via
+        :mod:`consensusml_tpu.models.paged_attention` — all bit-exact);
+        it is a static construction-time string, so each serving stage
+        fn compiles exactly one program either way.
 
         Serving hooks (:mod:`consensusml_tpu.serve`): ``return_kv=True``
         additionally returns each layer's ``(k, v)`` — (B, S, H, D) — for
@@ -187,6 +214,12 @@ class GPT2LM(nn.Module):
             raise ValueError(
                 "2-D positions (verify window) need kv_cache + block_table"
             )
+        if attn_impl != "gather" and block_table is None:
+            raise ValueError(
+                f"attn_impl={attn_impl!r} is the PAGED kernel tier and "
+                "needs block_table (the slot path has no fused kernel; "
+                "never silently fall back to the reference)"
+            )
         tok_emb = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="wte")
         x = tok_emb(input_ids)
         if positions is None:
@@ -211,7 +244,7 @@ class GPT2LM(nn.Module):
             if kv_cache is not None:
                 x, layer_cache = blk(
                     x, deterministic, kv_cache[i], positions,
-                    block_table=block_table,
+                    block_table=block_table, attn_impl=attn_impl,
                 )
                 new_caches.append(layer_cache)
             elif return_kv:

@@ -34,6 +34,10 @@ from consensusml_tpu.models.attention import (
     update_kv_cache,
 )
 from consensusml_tpu.models.losses import chunked_vocab_lm_loss, masked_lm_loss
+from consensusml_tpu.models.paged_attention import (
+    fused_paged_attention,
+    fused_paged_attention_window,
+)
 
 __all__ = ["LlamaConfig", "LlamaLM", "llama2_7b", "llama_tiny", "llama_loss_fn"]
 
@@ -130,6 +134,7 @@ class _LlamaBlock(nn.Module):
         positions=None,
         return_kv: bool = False,
         block_table=None,
+        attn_impl: str = "gather",
     ):
         c = self.config
         d = c.head_dim
@@ -158,13 +163,22 @@ class _LlamaBlock(nn.Module):
                     cache, k, v, block_table, positions
                 )
                 new_cache = {"k": k_pages, "v": v_pages}
-                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-                if rep != 1:
-                    kg = jnp.repeat(kg, rep, axis=2)
-                    vg = jnp.repeat(vg, rep, axis=2)
-                attn = cached_attention_window(
-                    q, kg, vg, positions=positions, dtype=c.dtype
-                )
+                if attn_impl == "gather":
+                    kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                    if rep != 1:
+                        kg = jnp.repeat(kg, rep, axis=2)
+                        vg = jnp.repeat(vg, rep, axis=2)
+                    attn = cached_attention_window(
+                        q, kg, vg, positions=positions, dtype=c.dtype
+                    )
+                else:
+                    # kernel tier (models/paged_attention.py): GQA
+                    # expansion happens INSIDE the fused pass, pages
+                    # stay pre-repeat — bit-exact vs the gather branch
+                    attn = fused_paged_attention_window(
+                        q, k_pages, v_pages, block_table,
+                        positions=positions, dtype=c.dtype, impl=attn_impl,
+                    )
             else:
                 # paged decode: block-pool pages store pre-repeat
                 # (kv_heads) rows; GQA expansion happens on the gather
@@ -172,13 +186,19 @@ class _LlamaBlock(nn.Module):
                     cache, k, v, block_table, positions
                 )
                 new_cache = {"k": k_pages, "v": v_pages}
-                kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
-                if rep != 1:
-                    kg = jnp.repeat(kg, rep, axis=2)
-                    vg = jnp.repeat(vg, rep, axis=2)
-                attn = cached_attention(
-                    q, kg, vg, lengths=lengths, dtype=c.dtype
-                )
+                if attn_impl == "gather":
+                    kg, vg = gather_paged_kv(k_pages, v_pages, block_table)
+                    if rep != 1:
+                        kg = jnp.repeat(kg, rep, axis=2)
+                        vg = jnp.repeat(vg, rep, axis=2)
+                    attn = cached_attention(
+                        q, kg, vg, lengths=lengths, dtype=c.dtype
+                    )
+                else:
+                    attn = fused_paged_attention(
+                        q, k_pages, v_pages, block_table,
+                        lengths=lengths, dtype=c.dtype, impl=attn_impl,
+                    )
         elif cache is not None:
             # decode: cache stores PRE-repeat (kv_heads) rows — GQA
             # expansion happens on the read, so the cache stays small
@@ -225,12 +245,15 @@ class LlamaLM(nn.Module):
         kv_cache: list | None = None,
         return_kv: bool = False,
         block_table: jax.Array | None = None,
+        attn_impl: str = "gather",
     ):
         """Serving hooks mirror :class:`~consensusml_tpu.models.gpt2.GPT2LM`:
         ``return_kv=True`` also returns per-layer pre-repeat ``(k, v)``
         for prefill insertion; ``kv_cache`` + ``positions`` runs one
         single-token decode step (against paged block pools when
-        ``block_table`` is given). The training path passes neither."""
+        ``block_table`` is given); ``attn_impl`` selects the paged-
+        attention tier (:mod:`consensusml_tpu.models.paged_attention` —
+        all impls bit-exact). The training path passes none of them."""
         c = self.config
         if kv_cache is not None and return_kv:
             raise ValueError("kv_cache (decode) and return_kv (prefill) are exclusive")
@@ -247,6 +270,12 @@ class LlamaLM(nn.Module):
             raise ValueError(
                 "2-D positions (verify window) need kv_cache + block_table"
             )
+        if attn_impl != "gather" and block_table is None:
+            raise ValueError(
+                f"attn_impl={attn_impl!r} is the PAGED kernel tier and "
+                "needs block_table (the slot path has no fused kernel; "
+                "never silently fall back to the reference)"
+            )
         x = nn.Embed(c.vocab_size, c.hidden, dtype=c.dtype, name="tok_emb")(input_ids)
         rope_table = rope_frequencies(c.head_dim, c.max_len, c.rope_theta)
         new_caches, kvs = [], []
@@ -255,7 +284,7 @@ class LlamaLM(nn.Module):
             if kv_cache is not None:
                 x, layer_cache = blk(
                     x, rope_table, kv_cache[i], positions,
-                    block_table=block_table,
+                    block_table=block_table, attn_impl=attn_impl,
                 )
                 new_caches.append(layer_cache)
             elif return_kv:

@@ -85,6 +85,13 @@ class ServeConfig:
     block_size: int = 8  # tokens per physical KV block (must divide max_len)
     num_blocks: int = 0  # pool size; 0 = num_slots * max_len/block_size + 1
     prefill_budget: int = 0  # prefill tokens per tick; 0 = one max_len bucket
+    # paged-attention tier (models/paged_attention.py): "gather" = the
+    # two-step reference (the measured default until TPU floor-ratio
+    # data flips it); "auto" resolves via resolve_attention_impl —
+    # compiled pallas on TPU, the interpreter elsewhere, NEVER silently
+    # the reference. All impls are bit-exact, so switching tiers never
+    # changes a stream.
+    attn_impl: str = "gather"  # "gather" | "jnp" | "interpret" | "pallas" | "auto"
 
 
 class Engine:
@@ -125,6 +132,21 @@ class Engine:
                 f"kv_impl must be 'paged' or 'slot', got {cfg.kv_impl!r}"
             )
         self.paged = cfg.kv_impl == "paged"
+        from consensusml_tpu.models.paged_attention import (
+            resolve_attention_impl,
+        )
+
+        # resolve ONCE at construction — "auto" means the kernel path
+        # (pallas on TPU, interpret elsewhere), and the resolved value
+        # is what stats()/the serve CLI report, so the executed tier is
+        # always the reported tier
+        self.attn_impl = resolve_attention_impl(cfg.attn_impl)
+        if self.attn_impl != "gather" and not self.paged:
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r} requires kv_impl='paged' "
+                "(the fused kernels read the block pool; the slot path "
+                "keeps its own parity baseline)"
+            )
         self._params = jax.device_put(params)
         if self.paged:
             from consensusml_tpu.serve import pool as P
@@ -151,7 +173,9 @@ class Engine:
                 dm, self._pool.num_blocks, cfg.block_size
             )
             self._prefill_fn = P.make_paged_prefill_fn(dm)
-            self._decode_fn = P.make_paged_decode_fn(dm)
+            self._decode_fn = P.make_paged_decode_fn(
+                dm, attn_impl=self.attn_impl
+            )
             self._sched = P.AdmissionScheduler(
                 cfg.prefill_budget or self.max_len
             )
@@ -196,8 +220,12 @@ class Engine:
                 sd, self._pool.num_blocks, cfg.block_size
             )
             self._draft_prefill_fn = P.make_paged_prefill_fn(sd)
-            self._propose_fn = P.make_draft_propose_fn(sd, self.spec.k)
-            self._verify_fn = P.make_verify_fn(dm, self.spec.k)
+            self._propose_fn = P.make_draft_propose_fn(
+                sd, self.spec.k, attn_impl=self.attn_impl
+            )
+            self._verify_fn = P.make_verify_fn(
+                dm, self.spec.k, attn_impl=self.attn_impl
+            )
             self._spec_extra_cols = (
                 P.spec_table_cols(
                     self._pool.blocks_per_slot, cfg.block_size, self.spec.k
@@ -693,13 +721,27 @@ class Engine:
             "max_len": self.max_len,
         }
         if self.paged:
+            from consensusml_tpu.models.paged_attention import (
+                resolve_attention_impl,
+            )
             from consensusml_tpu.serve.pool.stages import (
                 decode_cost_args,
+                make_paged_decode_fn,
                 prefill_cost_args,
             )
 
             pages = st(self._pages)
             bs = self.config.block_size
+            base_meta["attn_impl"] = self.attn_impl
+            # the KERNEL-tier impl for the side-by-side ".fused" rows:
+            # the engine's own tier when it already runs fused, else
+            # the auto resolution (pallas on TPU, interpret elsewhere —
+            # never the gather reference)
+            fused_impl = (
+                self.attn_impl
+                if self.attn_impl in ("interpret", "pallas")
+                else resolve_attention_impl("auto")
+            )
             for b in self.buckets:
                 name = f"serve.prefill.b{b}"
                 rows[name] = ledger.register(
@@ -714,6 +756,26 @@ class Engine:
                 ),
                 meta={
                     **base_meta,
+                    "num_blocks": self._pool.num_blocks,
+                    "block_size": bs,
+                },
+            )
+            # the fused decode step as its OWN row, so the attribution
+            # table shows fused vs gather side by side (same shapes,
+            # same load; AOT-only — no jit dispatch cache is touched)
+            fused_decode_fn = (
+                self._decode_fn
+                if self.attn_impl == fused_impl
+                else make_paged_decode_fn(self._dm, attn_impl=fused_impl)
+            )
+            rows["serve.decode.fused"] = ledger.register(
+                "serve.decode.fused", fused_decode_fn, params, pages,
+                *decode_cost_args(
+                    self.config.num_slots, self._pool.blocks_per_slot
+                ),
+                meta={
+                    **base_meta,
+                    "attn_impl": fused_impl,
                     "num_blocks": self._pool.num_blocks,
                     "block_size": bs,
                 },
@@ -750,6 +812,22 @@ class Engine:
                         self._dm.vocab_size,
                     ),
                     meta=spec_meta,
+                )
+                from consensusml_tpu.serve.pool.spec import make_verify_fn
+
+                fused_verify_fn = (
+                    self._verify_fn
+                    if self.attn_impl == fused_impl
+                    else make_verify_fn(self._dm, k, attn_impl=fused_impl)
+                )
+                rows["serve.spec.verify.fused"] = ledger.register(
+                    "serve.spec.verify.fused", fused_verify_fn, params,
+                    pages,
+                    *verify_cost_args(
+                        self.config.num_slots, cols, k,
+                        self._dm.vocab_size,
+                    ),
+                    meta={**spec_meta, "attn_impl": fused_impl},
                 )
         else:
             cache = st(self._cache)
@@ -816,6 +894,7 @@ class Engine:
         decode_time = self._decode_time_s
         out = {
             "kv_impl": self.config.kv_impl,
+            "attn_impl": self.attn_impl,
             "tokens_out": self._tokens_out,
             "decode_steps": self._decode_steps,
             "ttft_p50_ms": 1e3 * pct(self._ttfts, 50),

@@ -129,7 +129,9 @@ def verify_cost_args(
     )
 
 
-def make_draft_propose_fn(draft_dm: Any, k: int) -> Callable:
+def make_draft_propose_fn(
+    draft_dm: Any, k: int, attn_impl: str = "gather"
+) -> Callable:
     """``propose(draft_params, draft_pages, block_table, tokens (S,),
     positions (S,), temperature (S,), top_p (S,), seeds (S,))`` ->
     ``(proposals (S, k), q_sel (S, k), q_probs (S, k, V),
@@ -149,6 +151,11 @@ def make_draft_propose_fn(draft_dm: Any, k: int) -> Callable:
     self-draft fixture bit-exact). ``q_sel`` is the draft probability of
     each chosen token (the acceptance ratio's denominator), ``q_probs``
     the full distributions (the residual re-draw's subtrahend).
+
+    ``attn_impl`` (static) selects the paged-attention tier for every
+    scanned draft step (:mod:`consensusml_tpu.models.paged_attention`;
+    all impls bit-exact, so the self-draft fixture stays bit-exact on
+    the kernel tier too).
     """
     import jax
     import jax.numpy as jnp
@@ -176,6 +183,7 @@ def make_draft_propose_fn(draft_dm: Any, k: int) -> Callable:
                 positions=pos,
                 kv_cache=pages,
                 block_table=block_table,
+                attn_impl=attn_impl,
             )
             probs = adjusted_probs(logits[:, 0], temperature, top_p)
             nxt = categorical_from_probs(
@@ -199,7 +207,7 @@ def make_draft_propose_fn(draft_dm: Any, k: int) -> Callable:
     return jax.jit(propose, donate_argnums=_donate_cache())
 
 
-def make_verify_fn(dm: Any, k: int) -> Callable:
+def make_verify_fn(dm: Any, k: int, attn_impl: str = "gather") -> Callable:
     """``verify(params, pages, block_table, tokens (S,), proposals
     (S, k), q_sel (S, k), q_probs (S, k, V), positions (S,), temperature
     (S,), top_p (S,), seeds (S,))`` -> ``(n_accept (S,), final (S,),
@@ -212,6 +220,11 @@ def make_verify_fn(dm: Any, k: int) -> Callable:
     ``final`` (the residual replacement at the first rejected row, or
     the bonus draw when everything survived); the host reads back three
     small arrays and does pure int bookkeeping.
+
+    ``attn_impl`` (static) selects the paged-attention tier for the
+    k+1-window forward (:mod:`consensusml_tpu.models.paged_attention`:
+    the fused window kernel vs the gather reference — bit-exact either
+    way, so acceptance decisions are impl-independent).
     """
     import jax
     import jax.numpy as jnp
@@ -241,6 +254,7 @@ def make_verify_fn(dm: Any, k: int) -> Callable:
             positions=pos_mat,
             kv_cache=pages,
             block_table=block_table,
+            attn_impl=attn_impl,
         )
         # target distributions for every window row, same temp/top-p
         # transform as the draft applied (the acceptance ratio compares

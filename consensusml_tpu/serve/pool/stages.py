@@ -133,7 +133,7 @@ def make_paged_prefill_fn(dm: Any) -> Callable:
     return jax.jit(prefill, donate_argnums=_donate_cache())
 
 
-def make_paged_decode_fn(dm: Any) -> Callable:
+def make_paged_decode_fn(dm: Any, attn_impl: str = "gather") -> Callable:
     """``decode(params, pages, block_table (S, nb), tokens (S,),
     positions (S,), temperature (S,), top_p (S,), seeds (S,))`` ->
     ``(next_tokens (S,), new_pages)``.
@@ -146,6 +146,12 @@ def make_paged_decode_fn(dm: Any) -> Callable:
     assignments, AND sampling parameters are all DATA — one executable
     serves every greedy/sampled mix, the zero-recompile contract. Only
     the pages donate; the block table is reused across steps.
+
+    ``attn_impl`` is a construction-time static: "gather" keeps the
+    two-step gather + dense attention; "jnp"/"interpret"/"pallas" run
+    the fused paged-attention kernel tier
+    (:mod:`consensusml_tpu.models.paged_attention`) — one pallas pass
+    per layer, bit-exact vs gather, same zero-recompile contract.
     """
     import jax
     import jax.numpy as jnp
@@ -163,6 +169,7 @@ def make_paged_decode_fn(dm: Any) -> Callable:
             positions=positions,
             kv_cache=pages,
             block_table=block_table,
+            attn_impl=attn_impl,
         )
         toks = sample_token(
             logits[:, 0], temperature, top_p, seeds, positions

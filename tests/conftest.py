@@ -132,6 +132,13 @@ _SLOW_TESTS = {
     "test_incremental_decode_matches_full_forward",
     "test_decode_is_deterministic_across_batching",
     "test_export_roundtrip_and_meta",
+    # round-6 fused paged-attention additions measured >=5s. The
+    # acceptance-critical kernel-tier tests (engine stream parity both
+    # families, spec-engine parity, tight-pool preemption, the fuzz
+    # parity sweeps) deliberately STAY in the fast tier; these two are
+    # covered by them at engine level and pin secondary surfaces.
+    "test_register_costs_adds_fused_rows_side_by_side",
+    "test_model_decode_step_parity_per_family",
 }
 
 

@@ -60,6 +60,20 @@ def test_default_spec_is_well_formed():
         assert f"analysis.pass_seconds.{p}" in keys
     assert "analysis.lockdep_smoke_seconds" in keys
     assert "analysis.active_findings" in keys
+    # the fused kernel tier (ISSUE 16): bit-exactness + HBM-bytes gates
+    # on the serving fused_attention block, floor-ratio budgets (down
+    # trajectory AND absolute ceiling) per hot-path stage, compile
+    # walls on the two fused executables
+    assert "serving.fused_attention.bit_exact" in keys
+    assert "serving.fused_attention.hbm_bytes_ratio" in keys
+    for stage in ("serve_decode", "serve_decode_fused", "serve_prefill",
+                  "spec_verify", "spec_verify_fused"):
+        key = f"attribution.floor_ratio.{stage}"
+        dirs = {e["direction"] for e in mod.DEFAULT_SPEC
+                if e["key"] == key}
+        assert dirs == {"down", "max"}, key
+    assert "attribution.compile_ms.serve_decode_fused" in keys
+    assert "attribution.compile_ms.spec_verify_fused" in keys
 
 
 def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):
@@ -149,6 +163,48 @@ def test_attribution_budgets_enforced_on_fresh_result(tmp_path, capsys):
         r["key"]: r["status"] for r in doc["rows"]
     }
     assert ok["attribution.compile_ms.train_step"] == "ok"
+
+
+def test_fused_attention_gates_enforced_on_fresh_result(tmp_path, capsys):
+    """A fresh bench whose fused block lost bit-exactness, touched MORE
+    HBM bytes than the gather path, or whose floor ratios blew their
+    absolute ceilings fails; a healthy block passes the same gates."""
+    mod = _tool()
+
+    def run(serving, attribution):
+        fresh = {
+            "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+            "serving": serving,
+            "attribution": attribution,
+        }
+        path = tmp_path / "fresh.json"
+        path.write_text(json.dumps(fresh))
+        rc = mod.main([str(path), "--json", "-"])
+        return rc, json.loads(capsys.readouterr().out)
+
+    healthy_ratio = {
+        "serve_decode": 7.6, "serve_decode_fused": 6.0,
+        "serve_prefill": 5.0, "spec_verify": 5.7,
+        "spec_verify_fused": 8.3,
+    }
+    rc, doc = run(
+        {"fused_attention": {"bit_exact": 1, "hbm_bytes_ratio": 0.93}},
+        {"floor_ratio": dict(healthy_ratio)},
+    )
+    assert rc == 0, doc
+    blown = dict(healthy_ratio)
+    blown["serve_decode_fused"] = 250.0  # ceiling is 100x floor
+    rc, doc = run(
+        {"fused_attention": {"bit_exact": 0, "hbm_bytes_ratio": 1.2}},
+        {"floor_ratio": blown},
+    )
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "serving.fused_attention.bit_exact" in failed
+    assert "serving.fused_attention.hbm_bytes_ratio" in failed
+    assert "attribution.floor_ratio.serve_decode_fused" in failed
+    ok = {r["key"]: r["status"] for r in doc["rows"]}
+    assert ok["attribution.floor_ratio.serve_decode"] == "ok"
 
 
 def test_runs_clean_against_checked_in_trajectory(capsys):

@@ -154,7 +154,9 @@ def test_every_serving_executable_has_a_cost_row():
     with _tiny_engine() as eng:
         rows = eng.register_costs(led)
         expected = {f"serve.prefill.b{b}" for b in eng.buckets}
-        expected |= {"serve.decode", "serve.hotswap.stage"}
+        expected |= {
+            "serve.decode", "serve.decode.fused", "serve.hotswap.stage"
+        }
         assert set(rows) == expected
         assert set(led.names()) == expected
         for name in expected:

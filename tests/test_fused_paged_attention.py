@@ -1,0 +1,470 @@
+"""Fused pallas paged-attention kernel tier (ISSUE 16).
+
+The pinned properties:
+
+- **Bit-exact parity** — the fused kernel (interpret here; the compiled
+  TPU lowering shares the jaxpr) equals the two-step gather + dense
+  attention path to the last bit under randomized block tables:
+  arbitrary slot/length/block permutations, trash-block-0 padding
+  columns, ``device_table(extra_cols)`` overflow padding, MHA and GQA,
+  bf16 and f32, decode (W=1) and spec-verify windows.
+- **Engine parity** — the same prompts served under
+  ``attn_impl="gather"``, ``"interpret"``, and ``"auto"`` produce
+  identical token streams (greedy AND sampled lanes, both model
+  families, plain and speculative engines) with zero recompiles after
+  warmup, and a tight pool preempts-by-recompute on the fused path
+  exactly as on the gather path.
+- **Contract surface** — the fused stages trace exactly one
+  ``pallas_call`` per layer, the gather stages trace zero (the negative
+  fixture the jaxpr contract's fused-active detector leans on), the
+  kernel tier refuses the slot path and refuses to run without a block
+  table, and the profiler keeps ``fused_paged_attn_w1`` / ``_w{k+1}``
+  as distinct op families.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.models.paged_attention import (
+    ATTENTION_IMPLS,
+    fused_paged_attention,
+    fused_paged_attention_window,
+    resolve_attention_impl,
+)
+from consensusml_tpu.serve import Engine, ServeConfig, SpecConfig
+from consensusml_tpu.serve import decode as D
+from consensusml_tpu.serve import pool as P
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_gpt2(**over):
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    kw = dict(
+        vocab_size=64, hidden=32, layers=2, heads=2, max_len=32, dropout=0.0
+    )
+    kw.update(over)
+    return GPT2LM(config=GPT2Config(**kw))
+
+
+def _tiny_llama():
+    from consensusml_tpu.models.llama import llama_tiny
+
+    return llama_tiny(max_len=32)
+
+
+def _init(model, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def _f32(x):
+    # bf16 -> f32 is injective, so equality in f32 IS bit equality
+    return np.asarray(jnp.asarray(x, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Randomized block-table fuzz parity: fused == gather, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _rand_pages(rng, num_blocks, bs, hkv, d, dtype):
+    k = jnp.asarray(
+        rng.standard_normal((num_blocks, bs, hkv, d)), dtype
+    )
+    v = jnp.asarray(
+        rng.standard_normal((num_blocks, bs, hkv, d)), dtype
+    )
+    return k, v  # block 0 (trash) holds garbage like the live pool does
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("heads", [(4, 4), (4, 2)])  # MHA, GQA
+def test_fused_decode_fuzz_parity(dtype, heads):
+    """Arbitrary tables — permuted physical blocks, trash-0 columns past
+    the owned prefix, even aliased rows — and arbitrary lengths: the
+    fused decode step must equal the gather path bitwise on the SAME
+    inputs, every draw."""
+    h, hkv = heads
+    rng = np.random.default_rng(0)
+    for trial in range(6):
+        slots = int(rng.integers(1, 5))
+        nb = int(rng.integers(2, 5))
+        bs, d = 8, 8
+        num_blocks = slots * nb + 1
+        kp, vp = _rand_pages(rng, num_blocks, bs, hkv, d, dtype)
+        q = jnp.asarray(rng.standard_normal((slots, 1, h, d)), dtype)
+        table = np.zeros((slots, nb), np.int32)
+        for s in range(slots):
+            owned = int(rng.integers(1, nb + 1))
+            table[s, :owned] = rng.choice(
+                np.arange(1, num_blocks), size=owned, replace=False
+            )  # columns past the owned prefix stay TRASH_BLOCK (0)
+        lengths = rng.integers(1, nb * bs + 1, size=(slots,)).astype(
+            np.int32
+        )
+        out = {}
+        for impl in ("gather", "jnp", "interpret"):
+            out[impl] = fused_paged_attention(
+                q, kp, vp, jnp.asarray(table),
+                lengths=jnp.asarray(lengths), dtype=dtype, impl=impl,
+            )
+            assert out[impl].dtype == dtype
+        np.testing.assert_array_equal(
+            _f32(out["gather"]), _f32(out["interpret"]),
+            err_msg=f"trial {trial}: fused decode != gather",
+        )
+        np.testing.assert_array_equal(
+            _f32(out["gather"]), _f32(out["jnp"])
+        )
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_fused_window_fuzz_parity_with_overflow_padding(dtype):
+    """The spec-verify window over a REAL pool table widened by
+    ``device_table(extra_cols)``: overflow trash columns, arbitrary
+    per-row positions (including ones resolving into the trash region,
+    the near-``max_len`` overflow case) — fused == gather bitwise."""
+    rng = np.random.default_rng(1)
+    h, hkv, bs, d, w = 4, 2, 8, 8, 3
+    for trial in range(4):
+        slots, max_len = 3, 32
+        pool = P.BlockPool(slots, max_len, bs)
+        for s in range(slots):
+            pool.alloc(s, int(rng.integers(1, pool.blocks_per_slot + 1)))
+        extra = int(rng.integers(1, 3))
+        table = pool.device_table(extra)
+        cols = pool.blocks_per_slot + extra
+        assert table.shape == (slots, cols)
+        assert np.all(
+            np.asarray(table)[:, pool.blocks_per_slot:] == P.TRASH_BLOCK
+        )
+        kp, vp = _rand_pages(rng, pool.num_blocks, bs, hkv, d, dtype)
+        q = jnp.asarray(rng.standard_normal((slots, w, h, d)), dtype)
+        positions = rng.integers(
+            0, cols * bs, size=(slots, w)
+        ).astype(np.int32)
+        got = {
+            impl: fused_paged_attention_window(
+                q, kp, vp, table, positions=jnp.asarray(positions),
+                dtype=dtype, impl=impl,
+            )
+            for impl in ("gather", "interpret")
+        }
+        np.testing.assert_array_equal(
+            _f32(got["gather"]), _f32(got["interpret"]),
+            err_msg=f"trial {trial}: fused window != gather",
+        )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_model_decode_step_parity_per_family(family):
+    """One real paged decode step through the model blocks: logits AND
+    the written-back pages are bit-identical across impls (the fused
+    path shares the scatter; only the attention read fuses)."""
+    model = _tiny_gpt2() if family == "gpt2" else _tiny_llama()
+    params = _init(model)
+    dm = D.DecodeModel.wrap(model)
+    slots, max_len, bs = 2, 32, 8
+    pool = P.BlockPool(slots, max_len, bs)
+    pool.alloc(0, 2)
+    pool.alloc(1, 1)
+    pages = P.init_pages(dm, pool.num_blocks, bs)
+    tokens = jnp.asarray([5, 9], jnp.int32)
+    positions = jnp.asarray([9, 3], jnp.int32)
+
+    def step(impl):
+        return model.apply(
+            {"params": params}, tokens[:, None], deterministic=True,
+            positions=positions, kv_cache=pages,
+            block_table=pool.device_table(), attn_impl=impl,
+        )
+
+    logits_g, pages_g = step("gather")
+    logits_f, pages_f = step("interpret")
+    np.testing.assert_array_equal(np.asarray(logits_g), np.asarray(logits_f))
+    for lg, lf in zip(pages_g, pages_f):
+        np.testing.assert_array_equal(_f32(lg["k"]), _f32(lf["k"]))
+        np.testing.assert_array_equal(_f32(lg["v"]), _f32(lf["v"]))
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: gather vs interpret vs auto, greedy + sampled lanes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_fused_engine_streams_match_gather(family):
+    """The SAME prompts — half greedy, half sampled — served under every
+    attn tier produce identical token streams with zero recompiles
+    after warmup, and stats() reports the RESOLVED tier ("auto" is the
+    interpreter on this CPU host, never silently the reference)."""
+    model = _tiny_gpt2() if family == "gpt2" else _tiny_llama()
+    vocab = model.config.vocab_size
+    params = _init(model)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, vocab - 1, size=n).tolist() for n in (2, 5, 9, 13)]
+
+    def serve(impl):
+        cfg = ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", attn_impl=impl
+        )
+        with Engine(model, params, cfg) as eng:
+            warm = eng.warmup()
+            handles = [
+                eng.submit(
+                    p, 6, temperature=0.0 if i % 2 == 0 else 0.9,
+                    top_p=0.9, seed=100 + i,
+                )
+                for i, p in enumerate(prompts)
+            ]
+            toks = [h.result(timeout=120).tokens for h in handles]
+            stats = eng.stats()
+            assert stats["compile_counts"] == warm, (
+                f"attn_impl={impl!r} recompiled after warmup"
+            )
+            return toks, stats["attn_impl"]
+
+    gather, g_impl = serve("gather")
+    fused, f_impl = serve("interpret")
+    auto, a_impl = serve("auto")
+    assert gather == fused == auto
+    assert (g_impl, f_impl, a_impl) == ("gather", "interpret", "interpret")
+
+
+def test_fused_spec_engine_matches_gather_spec_engine():
+    """Speculative decode (self-draft fixture) under the kernel tier:
+    propose + fused k+1 verify reproduce the gather spec engine's
+    streams bit for bit at acceptance 1.0, zero recompiles after
+    warmup."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 63, size=n).tolist() for n in (2, 6, 11)]
+
+    def serve(impl):
+        with Engine(
+            model, params,
+            ServeConfig(
+                num_slots=4, max_len=32, kv_impl="paged", attn_impl=impl
+            ),
+            spec_decode=SpecConfig(model=model, params=params, k=3),
+        ) as eng:
+            warm = eng.warmup()
+            handles = [
+                eng.submit(p, 8, temperature=0.7, top_p=0.9, seed=i)
+                for i, p in enumerate(prompts)
+            ]
+            toks = [h.result(timeout=120).tokens for h in handles]
+            stats = eng.stats()
+            assert stats["compile_counts"] == warm
+            assert stats["spec"]["acceptance_rate"] == 1.0
+            return toks
+
+    assert serve("gather") == serve("interpret")
+
+
+def test_tight_pool_recompute_preemption_on_fused_path():
+    """Structural eviction pressure on the KERNEL tier: blocks free, the
+    stream re-enqueues and recomputes through the fused stages — every
+    stream completes token-identical to a roomy gather engine."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    prompts = [
+        np.random.default_rng(i).integers(0, 63, size=4 + 3 * i).tolist()
+        for i in range(4)
+    ]
+    max_new = 16
+
+    def serve(impl, num_blocks):
+        cfg = ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", block_size=8,
+            num_blocks=num_blocks, attn_impl=impl,
+        )
+        with Engine(model, params, cfg) as eng:
+            eng.warmup()
+            handles = [eng.submit(p, max_new) for p in prompts]
+            results = [h.result(timeout=120) for h in handles]
+            stats = eng.stats()
+            eng._pool.check()
+        return results, stats
+
+    tight, tight_stats = serve("interpret", num_blocks=10)
+    roomy, roomy_stats = serve("gather", num_blocks=0)
+    assert tight_stats["evictions"] > 0 and roomy_stats["evictions"] == 0
+    assert [r.tokens for r in tight] == [r.tokens for r in roomy]
+    assert all(len(r.tokens) == max_new for r in tight)
+
+
+# ---------------------------------------------------------------------------
+# Impl resolution + guard rails
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_attention_impl_semantics():
+    # this suite pins the CPU host: "auto" is the interpreter — the
+    # kernel path's jaxpr — never the gather reference
+    assert resolve_attention_impl("auto") == "interpret"
+    for impl in ATTENTION_IMPLS:
+        assert resolve_attention_impl(impl) == impl
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        resolve_attention_impl("fast")
+
+
+def test_kernel_tier_refuses_slot_path_and_missing_table():
+    model = _tiny_gpt2()
+    params = _init(model)
+    with pytest.raises(ValueError, match="paged"):
+        Engine(
+            model, params,
+            ServeConfig(
+                num_slots=1, max_len=32, kv_impl="slot",
+                attn_impl="interpret",
+            ),
+        )
+    # model-level guard: the kernel tier without a block table raises
+    # instead of silently composing the reference
+    dm = D.DecodeModel.wrap(model)
+    cache = D.init_cache(dm, 1, 32)
+    with pytest.raises(ValueError, match="never silently"):
+        model.apply(
+            {"params": params}, jnp.zeros((1, 1), jnp.int32),
+            deterministic=True, positions=jnp.zeros((1,), jnp.int32),
+            kv_cache=cache, attn_impl="interpret",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Traced-program contracts: one pallas_call per layer; gather = zero
+# ---------------------------------------------------------------------------
+
+
+def test_fused_stages_trace_one_kernel_per_layer():
+    """The fused decode step and the fused spec verify trace exactly
+    ``layers`` pallas_calls; the gather stages trace ZERO — the negative
+    fixture that keeps the jaxpr contract's fused-active detector
+    honest (an impl that refuses to fuse trips it)."""
+    from consensusml_tpu.analysis.jaxpr_contracts import count_primitives
+
+    model = _tiny_gpt2()
+    layers = model.config.layers
+    dm = D.DecodeModel.wrap(model)
+    slots, max_len, bs, k = 2, 32, 8, 2
+    nb = max_len // bs
+    num_blocks = slots * nb + 1
+    params = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    )
+    pages = jax.eval_shape(lambda: P.init_pages(dm, num_blocks, bs))
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    samp = (
+        jax.ShapeDtypeStruct((slots,), jnp.float32),
+        jax.ShapeDtypeStruct((slots,), jnp.float32),
+        jax.ShapeDtypeStruct((slots,), jnp.uint32),
+    )
+    dec_args = (params, pages, i32(slots, nb), i32(slots), i32(slots), *samp)
+    kernels = lambda fn, args: count_primitives(
+        jax.make_jaxpr(fn)(*args)
+    ).get("pallas_call", 0)
+    assert kernels(
+        P.make_paged_decode_fn(dm, attn_impl="interpret"), dec_args
+    ) == layers
+    assert kernels(P.make_paged_decode_fn(dm), dec_args) == 0
+
+    cols = P.spec_table_cols(nb, bs, k)
+    props, q_sel, q_probs, _ = jax.eval_shape(
+        P.make_draft_propose_fn(dm, k),
+        params, pages, i32(slots, cols), i32(slots), i32(slots), *samp,
+    )
+    ver_args = (
+        params, pages, i32(slots, cols), i32(slots), props, q_sel,
+        q_probs, i32(slots), *samp,
+    )
+    assert kernels(
+        P.make_verify_fn(dm, k, attn_impl="interpret"), ver_args
+    ) == layers
+    assert kernels(P.make_verify_fn(dm, k), ver_args) == 0
+
+
+def test_jaxpr_contract_passes_on_causal_lm_config():
+    """The shipped contract (`cml_check --jaxpr`) runs clean on a real
+    causal-LM config — fused-active, kernel-count, purity, hash-stable,
+    and the in-check negative fixture all PASS."""
+    from consensusml_tpu import configs
+    from consensusml_tpu.analysis import jaxpr_contracts as jc
+
+    bundle = configs.build("gpt2_topk", scale="smoke")
+    findings = jc._check_fused_attention_jaxprs("gpt2_topk", bundle)
+    assert findings == [], [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Profiler family identity: w1 vs w{k+1} never merge
+# ---------------------------------------------------------------------------
+
+
+def test_xprof_keeps_fused_kernel_families_distinct():
+    """`fused_paged_attn_w1` (decode) and `_w4` (k=3 verify) are
+    separate attribution rows; only XLA's `.N` uniquified duplicates
+    (bare sibling present) fold into their base."""
+    spec = importlib.util.spec_from_file_location(
+        "xprof_summary", os.path.join(REPO, "tools", "xprof_summary.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    raw = {
+        "fused_paged_attn_w1", "fused_paged_attn_w1.1",
+        "fused_paged_attn_w4",
+    }
+    fam = lambda n: mod.op_family(n, raw)
+    assert fam("fused_paged_attn_w1") == "fused_paged_attn_w1"
+    assert fam("fused_paged_attn_w4") == "fused_paged_attn_w4"
+    # XLA duplicates of the SAME kernel fold into their bare base...
+    assert fam("fused_paged_attn_w1.1") == "fused_paged_attn_w1"
+    assert fam("fused_paged_attn_w4.2") == "fused_paged_attn_w4"
+    # ...but a dotted name with NO bare sibling in the trace keeps its
+    # full identity (never merged into a DIFFERENT kernel's row)
+    assert mod.op_family(
+        "fused_paged_attn_w4.2", {"fused_paged_attn_w1"}
+    ) == "fused_paged_attn_w4.2"
+
+
+# ---------------------------------------------------------------------------
+# Cost-ledger rows: fused vs gather side by side
+# ---------------------------------------------------------------------------
+
+
+def test_register_costs_adds_fused_rows_side_by_side():
+    from consensusml_tpu.obs import CostLedger
+
+    model = _tiny_gpt2()
+    params = _init(model)
+    with Engine(
+        model, params,
+        ServeConfig(num_slots=2, max_len=32, kv_impl="paged"),
+        spec_decode=SpecConfig(model=model, params=params, k=2),
+    ) as eng:
+        ledger = CostLedger()
+        rows = eng.register_costs(ledger)
+    assert {"serve.decode", "serve.decode.fused"} <= set(rows)
+    assert {"serve.spec.verify", "serve.spec.verify.fused"} <= set(rows)
+    dec, fused = rows["serve.decode"], rows["serve.decode.fused"]
+    assert dec.meta["attn_impl"] == "gather"
+    assert fused.meta["attn_impl"] == "interpret"  # auto on this host
+    # the fused row must be its own cost model, not a relabeled copy:
+    # no HBM-materialized gather ⇒ strictly cheaper on the ledger
+    assert fused.flops < dec.flops
+    v, vf = rows["serve.spec.verify"], rows["serve.spec.verify.fused"]
+    assert vf.meta["attn_impl"] == "interpret" and vf.flops < v.flops

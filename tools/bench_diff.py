@@ -135,6 +135,45 @@ DEFAULT_SPEC = [
     {"key": "analysis.active_findings", "direction": "max", "bound": 0.0},
     {"key": "analysis.lockdep_smoke_seconds", "direction": "max",
      "bound": 30.0},
+    # fused paged-attention kernel tier (ISSUE 16, docs/perf.md
+    # "Roofline workflow"): the fused decode must stay bit-exact vs the
+    # two-step gather path and must touch NO MORE HBM bytes than it
+    # (the whole point of fusing is the gathered view never landing in
+    # HBM — the ledger's compiled bytes_accessed is the witness), and
+    # the floor-ratio gates are the self-driving part: each serving hot-
+    # path stage's measured-over-roofline ratio ratchets DOWN with the
+    # archive trajectory and holds an absolute order-of-magnitude
+    # ceiling (CPU-tier programs are dispatch-bound at ~5-8x floor; a
+    # three-digit ratio means a stage's lowering or measurement broke,
+    # whatever the archive says)
+    {"key": "serving.fused_attention.bit_exact", "direction": "min",
+     "bound": 1.0},
+    {"key": "serving.fused_attention.hbm_bytes_ratio", "direction": "max",
+     "bound": 1.0},
+    {"key": "attribution.floor_ratio.serve_decode", "direction": "down",
+     "tol_pct": 60.0},
+    {"key": "attribution.floor_ratio.serve_decode", "direction": "max",
+     "bound": 100.0},
+    {"key": "attribution.floor_ratio.serve_decode_fused",
+     "direction": "down", "tol_pct": 60.0},
+    {"key": "attribution.floor_ratio.serve_decode_fused",
+     "direction": "max", "bound": 100.0},
+    {"key": "attribution.floor_ratio.serve_prefill", "direction": "down",
+     "tol_pct": 60.0},
+    {"key": "attribution.floor_ratio.serve_prefill", "direction": "max",
+     "bound": 100.0},
+    {"key": "attribution.floor_ratio.spec_verify", "direction": "down",
+     "tol_pct": 60.0},
+    {"key": "attribution.floor_ratio.spec_verify", "direction": "max",
+     "bound": 100.0},
+    {"key": "attribution.floor_ratio.spec_verify_fused",
+     "direction": "down", "tol_pct": 60.0},
+    {"key": "attribution.floor_ratio.spec_verify_fused",
+     "direction": "max", "bound": 100.0},
+    {"key": "attribution.compile_ms.serve_decode_fused",
+     "direction": "max", "bound": 60000.0},
+    {"key": "attribution.compile_ms.spec_verify_fused",
+     "direction": "max", "bound": 60000.0},
 ]
 
 

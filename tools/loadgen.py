@@ -406,6 +406,15 @@ def main(argv=None) -> int:
             spec_k=args.spec_k,
         )
         engine.warmup()
+        # the resolved attention tier, loudly: "auto" means the KERNEL
+        # path resolved at engine construction — the executed tier must
+        # always be the reported tier (models/paged_attention.py)
+        print(
+            f"engine: kv_impl={engine.config.kv_impl} "
+            f"attn_impl={engine.attn_impl} "
+            f"(requested {engine.config.attn_impl!r})",
+            flush=True,
+        )
         vocab = engine._dm.vocab_size
         submit = _engine_submit(engine)
         if args.swap_every:
