@@ -1376,7 +1376,117 @@ def _obs_bench() -> dict:
     }
     out.update(_request_tracing_bench())
     out.update(_history_alert_bench(round_ms, cadence))
+    out.update(_wide_event_bench())
     return out
+
+
+def _wide_event_bench() -> dict:
+    """Wide-event accounting cost + the rollup-consistency gate
+    (docs/observability.md "Wide events & tenant accounting", gated by
+    tools/bench_diff.py).
+
+    A tiny multi-tenant engine run produces real terminal wide events;
+    the per-tenant rollup must re-derive the engine's own request/token
+    totals EXACTLY (``tenant_rollup_mismatch`` gated at 0 — a join that
+    doesn't balance is worse than no join). The marginal engine-side
+    cost — one ``emit()`` per terminal request, ring append only, JSONL
+    sink off as it ships — is micro-timed and amortized over that
+    request's tokens against the measured decode step, plus a
+    ``rollup()`` (what a ``/tenants`` poll pays) amortized over a 15 s
+    scrape interval (<1% absolute budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+    from consensusml_tpu.obs.events import (
+        WideEventLog,
+        get_wide_event_log,
+        reset_wide_event_log,
+    )
+    from consensusml_tpu.serve import Engine, ServeConfig
+
+    slots, max_new = 8, 16
+    model = GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=64,
+            dropout=0.0,
+        )
+    )
+    params = model.init(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    # fresh log: the request-tracing bench's engine already emitted into
+    # the process singleton, and the consistency check below must see
+    # exactly THIS run's events
+    reset_wide_event_log()
+    engine = Engine(
+        model, params,
+        ServeConfig(num_slots=slots, max_len=64, max_new_tokens=max_new),
+    )
+    tenants = ("alpha", "beta", "gamma")
+    try:
+        engine.warmup()
+        handles = [
+            engine.submit(
+                [1 + (i % 50)] * (4 + i % 9),
+                tenant=tenants[i % len(tenants)],
+            )
+            for i in range(24)
+        ]
+        results = [h.result(timeout=300) for h in handles]
+        stats = engine.stats()
+        step_ms = stats["intertoken_p50_ms"]
+        log = get_wide_event_log()
+        roll = log.rollup()
+    finally:
+        engine.shutdown(drain=False)
+
+    # the join must balance: events-derived totals == engine totals
+    mismatch = abs(
+        sum(r["requests"] for r in roll.values()) - len(results)
+    )
+    mismatch += abs(
+        sum(r["tokens_out"] for r in roll.values()) - stats["tokens_out"]
+    )
+    mismatch += abs(
+        sum(r["tokens_in"] for r in roll.values()) - stats["tokens_in"]
+    )
+
+    # micro-costs against a throwaway log, replaying a REAL event dict
+    sample = (
+        dict(log.events(n=1)[0]) if len(log)
+        else {"tenant": "alpha", "tokens_out": 0}
+    )
+    probe = WideEventLog()
+    n = 20000
+    t0 = time.time()
+    for _ in range(n):
+        probe.emit(dict(sample))
+    emit_us = 1e6 * (time.time() - t0) / n
+    t0 = time.time()
+    for _ in range(100):
+        probe.rollup()
+    rollup_ms = 1000 * (time.time() - t0) / 100
+
+    # per-step model: emits happen once per request (slots/max_new
+    # terminals per step), a rollup once per 15 s scrape window
+    admissions_per_step = slots / max_new
+    steps_per_scrape = max(15e3 / max(step_ms, 1e-9), 1.0)
+    per_step_ms = (
+        admissions_per_step * emit_us / 1e3 + rollup_ms / steps_per_scrape
+    )
+    return {
+        "wide_event_emit_us": round(emit_us, 3),
+        "wide_event_rollup_ms": round(rollup_ms, 4),
+        "wide_event_tenants": len(roll),
+        "wide_event_per_step_ms": round(per_step_ms, 5),
+        "wide_event_overhead_pct": round(
+            100 * per_step_ms / max(step_ms, 1e-9), 3
+        ),
+        # MUST be 0: the cost join is only trustworthy if the rollup
+        # re-derives the engine's own totals (bench_diff gates at 0)
+        "tenant_rollup_mismatch": int(mismatch),
+    }
 
 
 def _history_alert_bench(gossip_round_ms: float, cadence: int) -> dict:

@@ -26,6 +26,18 @@ The REQUEST plane makes serving explain itself per request
   ``/requests`` (``train.py --metrics-port``,
   ``ServeServer(metrics_port=...)``).
 
+The WIDE-EVENT plane joins them per request (docs/observability.md
+"Wide events & tenant accounting"):
+
+- :mod:`~consensusml_tpu.obs.events` — ONE structured record per
+  terminal serving request (trace timings + token counts + pool
+  block-seconds + ledger-joined FLOPs/HBM bytes + tenant label) in a
+  bounded :class:`WideEventLog` ring with an optional JSONL sink;
+  per-tenant :meth:`~WideEventLog.rollup` aggregates, labeled
+  ``consensusml_tenant_*`` families (per-tenant burn-rate SLOs ride
+  the alert plane's labeled-children matching), and ``GET /events`` /
+  ``/tenants`` on the live HTTP plane.
+
 The COST plane attributes time and memory (docs/observability.md "Cost
 attribution", docs/memory.md "Reconciliation"):
 
@@ -93,6 +105,12 @@ from consensusml_tpu.obs.alerts import (  # noqa: F401
     default_ruleset,
     get_alert_engine,
     peek_alert_engine,
+)
+from consensusml_tpu.obs.events import (  # noqa: F401
+    WideEventLog,
+    get_wide_event_log,
+    peek_wide_event_log,
+    sanitize_tenant,
 )
 from consensusml_tpu.obs.flight import FlightRecorder  # noqa: F401
 from consensusml_tpu.obs.history import (  # noqa: F401

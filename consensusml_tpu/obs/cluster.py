@@ -63,6 +63,7 @@ class ClusterWriter:
         tracer=None,
         history=None,
         alerts=None,
+        events=None,
     ):
         from consensusml_tpu.obs.tracer import get_tracer
 
@@ -78,6 +79,10 @@ class ClusterWriter:
         # a custom registry never picks up the global plane's digests
         self.history = history
         self.alerts = alerts
+        # wide-event log (obs.events): same explicit-or-peek rule — a
+        # serving rank's snapshot carries its per-tenant rollup so the
+        # aggregator can merge fleet-wide tenant spend
+        self.events = events
         self._peek_global = registry is None
         # span-ring digest source: per-round phase rows for the merged
         # round timeline (tracer disabled => no digest in the snapshot)
@@ -119,16 +124,23 @@ class ClusterWriter:
                 doc["span_digest"] = digest
         alerts = self.alerts
         history = self.history
+        events = self.events
         if self._peek_global:
             from consensusml_tpu.obs.alerts import peek_alert_engine
+            from consensusml_tpu.obs.events import peek_wide_event_log
             from consensusml_tpu.obs.history import peek_history
 
             alerts = alerts or peek_alert_engine()
             history = history or peek_history()
+            events = events or peek_wide_event_log()
         if alerts is not None:
             doc["alerts"] = alerts.snapshot()
         if history is not None:
             doc["history"] = history.digest(points=32)
+        if events is not None:
+            # rollup only (events_recent capped small): a snapshot is
+            # rewritten at cadence, the full ring stays in-process
+            doc["wide_events"] = events.snapshot(last_n=16)
         if extra:
             doc.update(extra)
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -517,6 +529,43 @@ def _history_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def _tenants_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Fleet-wide per-tenant spend: every snapshot's wide-event rollup
+    merged by tenant — counters sum across ranks (each rank's events
+    are its own requests, disjoint by construction), worst-TTFT
+    exemplar lists merge and re-cap. None when no snapshot carries a
+    wide-event section (pre-accounting snapshots keep aggregating)."""
+    from consensusml_tpu.obs.events import WORST_TTFT_KEEP
+
+    tenants: dict[str, dict[str, Any]] = {}
+    reporting = 0
+    events_total = 0
+    for s in snaps:
+        we = s.get("wide_events")
+        if not isinstance(we, dict):
+            continue
+        reporting += 1
+        events_total += int(we.get("emitted_total") or 0)
+        for t, agg in (we.get("tenants") or {}).items():
+            row = tenants.setdefault(t, {"worst_ttft": []})
+            for k, v in agg.items():
+                if k == "worst_ttft":
+                    row["worst_ttft"].extend(v or [])
+                elif isinstance(v, (int, float)):
+                    row[k] = row.get(k, 0) + v
+        for row in tenants.values():
+            row["worst_ttft"] = sorted(
+                row["worst_ttft"], key=lambda r: -(r.get("ttft_s") or 0.0)
+            )[:WORST_TTFT_KEEP]
+    if not reporting:
+        return None
+    return {
+        "ranks_reporting": reporting,
+        "events_total": events_total,
+        "tenants": tenants,
+    }
+
+
 def _hbm_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
     """The three-way HBM reconciliation gauges (obs/memviz.py), worst
     rank per side — plus per-pair drift. None when no rank reconciled."""
@@ -860,6 +909,10 @@ def aggregate(
         # pre-alert-plane snapshots keep aggregating
         "alerts": _alerts_section(ranks + others),
         "history": _history_section(ranks + others),
+        # the wide-event plane: fleet-wide per-tenant spend merged from
+        # each snapshot's rollup (docs/observability.md "Wide events &
+        # tenant accounting"); None when no snapshot carries one
+        "tenants": _tenants_section(ranks + others),
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

@@ -56,6 +56,7 @@ class FlightRecorder:
         requests: RequestTraceRegistry | None = None,
         history=None,
         alerts=None,
+        events=None,
     ):
         self.out_dir = out_dir
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -71,6 +72,11 @@ class FlightRecorder:
         # digests next to a different registry's metrics
         self.history = history
         self.alerts = alerts
+        # wide-event log (obs.events): same explicit-or-peek rule — a
+        # serving crash's dump carries the last-N terminal wide events
+        # and the per-tenant rollup, never creating a log as a dump
+        # side effect
+        self.events = events
         self._peek_global = registry is None
         self._installed = False
         self._prev_excepthook = None
@@ -110,12 +116,15 @@ class FlightRecorder:
             }
             alerts = self.alerts
             hist = self.history
+            events = self.events
             if self._peek_global:
                 from consensusml_tpu.obs.alerts import peek_alert_engine
+                from consensusml_tpu.obs.events import peek_wide_event_log
                 from consensusml_tpu.obs.history import peek_history
 
                 alerts = alerts or peek_alert_engine()
                 hist = hist or peek_history()
+                events = events or peek_wide_event_log()
             if alerts is not None:
                 # what was already WRONG when the process died
                 doc["alerts"] = alerts.snapshot()
@@ -123,6 +132,10 @@ class FlightRecorder:
                 # the last-N trend of every series — whether the breach
                 # was a cliff or a slow burn
                 doc["history"] = hist.digest()
+            if events is not None:
+                # who was consuming what when the process died: the
+                # last-N terminal wide events + per-tenant rollup
+                doc["wide_events"] = events.snapshot()
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(doc, f)

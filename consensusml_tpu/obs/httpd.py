@@ -22,6 +22,13 @@ textfile writer uses, freshly rendered per GET, so a Prometheus scraper
   up, last history-tick age, firing-alert count. Returns **503** when
   this server OWNS the tick cadence (``tick_s > 0``) and ticks stopped
   landing — a wedged serving process stops being routable;
+- ``GET /events?n=N&tenant=T`` — the newest wide events from the
+  request-accounting log, optionally filtered to one tenant
+  (docs/observability.md "Wide events & tenant accounting");
+- ``GET /tenants`` — the per-tenant rollup: requests, tokens, joined
+  TFLOPs, HBM gigabytes, block-seconds, worst-TTFT exemplars. Both
+  PEEK the global log (wired ``events=`` wins): a scrape must never
+  create one, so an un-armed process answers with an empty doc;
 - ``GET /profile?ms=N`` — an ON-DEMAND ``jax.profiler`` capture of the
   next N milliseconds of whatever this process is doing (a live train
   loop, a serving engine mid-traffic) — no restart, no ``--profile-dir``
@@ -135,6 +142,7 @@ class MetricsServer:
         history=None,
         alerts=None,
         tick_s: float = 0.0,
+        events=None,
     ):
         registry = registry if registry is not None else get_registry()
         tracer = tracer if tracer is not None else get_tracer()
@@ -145,6 +153,10 @@ class MetricsServer:
         # record()/evaluate() on the obs-ticker thread
         self.history = history
         self.alerts = alerts
+        # WideEventLog (obs.events): /events and /tenants surface it;
+        # None means peek-at-request-time — the serving engine arms the
+        # global log, a scrape never creates one
+        self.events = events
         self.tick_s = float(tick_s)
         server = self
 
@@ -211,6 +223,12 @@ class MetricsServer:
                 elif path == "/healthz":
                     code, doc = server._healthz_doc()
                     self._send_json(code, _jsonsafe(doc))
+                elif path == "/events":
+                    code, doc = server._events_doc(parse_qs(url.query))
+                    self._send_json(code, _jsonsafe(doc))
+                elif path == "/tenants":
+                    code, doc = server._tenants_doc()
+                    self._send_json(code, _jsonsafe(doc))
                 elif path == "/profile":
                     self._send_json(*server._profile(parse_qs(url.query)))
                 else:
@@ -218,7 +236,8 @@ class MetricsServer:
                         404,
                         {
                             "error": "try /metrics, /traces, /requests, "
-                                     "/alerts, /query, /healthz, /profile"
+                                     "/alerts, /query, /healthz, /events, "
+                                     "/tenants, /profile"
                         },
                     )
 
@@ -324,6 +343,40 @@ class MetricsServer:
                 len(self.history) if self.history is not None else 0
             ),
         }
+
+    # -- /events /tenants --------------------------------------------------
+
+    def _event_log(self):
+        """Wired log, else the global PEEKED (never created — the
+        engine's terminal funnel arms it; a scrape must not)."""
+        if self.events is not None:
+            return self.events
+        from consensusml_tpu.obs.events import peek_wide_event_log
+
+        return peek_wide_event_log()
+
+    def _events_doc(self, query: dict) -> tuple[int, dict]:
+        log = self._event_log()
+        if log is None:
+            return 200, {"enabled": False, "events": [], "emitted_total": 0}
+        try:
+            n = query.get("n")
+            count = int(n[0]) if n else 64
+        except (TypeError, ValueError):
+            return 400, {"error": "n must be an integer"}
+        tenant = (query.get("tenant") or [None])[0]
+        return 200, {
+            "enabled": True,
+            "emitted_total": log.emitted_total,
+            "retained": len(log),
+            "events": log.events(count, tenant=tenant),
+        }
+
+    def _tenants_doc(self) -> tuple[int, dict]:
+        log = self._event_log()
+        if log is None:
+            return 200, {"enabled": False, "tenants": {}}
+        return 200, {"enabled": True, "tenants": log.rollup()}
 
     # -- /profile ---------------------------------------------------------
 

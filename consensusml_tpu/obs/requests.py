@@ -100,7 +100,7 @@ class RequestTrace:
         "trace_id", "request_id", "prompt_len", "t_start_us",
         "events", "decode_ticks", "defer_ticks", "preemptions",
         "generation", "finish_reason", "t_end_us",
-        "spec_proposed", "spec_accepted",
+        "spec_proposed", "spec_accepted", "tenant",
     )
 
     def __init__(self, ctx: TraceContext, prompt_len: int, ts_us: float):
@@ -119,6 +119,9 @@ class RequestTrace:
         # rejected drafts attached, not as unexplained decode ticks
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # per-workload attribution label (obs/events.py; the engine's
+        # submit() resolves it, "default" for unlabeled clients)
+        self.tenant = "default"
         self.finish_reason: str | None = None
         self.t_end_us: float | None = None
 
@@ -138,6 +141,7 @@ class RequestTrace:
             "generation": self.generation,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            "tenant": self.tenant,
             # rounding happens at export, never on the hot append path
             "events": [
                 dict(e, ts_us=round(e["ts_us"], 3)) for e in self.events
@@ -175,9 +179,13 @@ class RequestTraceRegistry:
 
     # -- engine-side recording --------------------------------------------
     def start(self, ctx: TraceContext, prompt_len: int, **attrs) -> RequestTrace:
-        """Open a trace and record its ``submit`` event."""
+        """Open a trace and record its ``submit`` event. A ``tenant``
+        attr additionally lands as the trace's attribution label (it
+        still rides the submit event like any other attr)."""
         ts = self._now_us()
         tr = RequestTrace(ctx, prompt_len, ts)
+        if "tenant" in attrs:
+            tr.tenant = str(attrs["tenant"])
         ev: dict[str, Any] = {"name": "submit", "ts_us": ts}
         if attrs:
             ev.update(attrs)

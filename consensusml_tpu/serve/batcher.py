@@ -48,6 +48,12 @@ class GenResult:
     # engine): proposed = draft tokens offered, accepted = survivors
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # per-tenant attribution (obs/events.py): the workload label the
+    # request carried through submit(); "default" for unlabeled clients
+    tenant: str = "default"
+    # pool block-seconds this stream held, integrated over hold time
+    # (survives preemption + re-admission; 0.0 on the slot-cache path)
+    block_seconds: float = 0.0
 
 
 class RequestHandle:
@@ -111,6 +117,17 @@ class Request:
     # speculative accounting (engine-thread writes, _finish echoes)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # tenant label for per-workload attribution (submit() resolves;
+    # obs/events.py sanitizes at the boundary)
+    tenant: str = "default"
+    # wide-event accounting accumulators (engine-thread writes; they
+    # survive recompute-preemption because the REQUEST re-enqueues):
+    # decode steps this stream was resident for, pool block-seconds
+    # held, and the prefill bucket of every admission (a continuation
+    # re-prefills into a possibly larger bucket)
+    decode_ticks: int = 0
+    block_seconds: float = 0.0
+    prefill_buckets: list[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

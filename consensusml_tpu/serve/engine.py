@@ -252,7 +252,20 @@ class Engine:
         # prefill → decode → completion story (obs/requests.py; the
         # flight recorder dumps this registry on a serving crash)
         self._rt = get_request_registry()
+        from consensusml_tpu.obs.events import get_wide_event_log
+
+        # wide-event accounting (obs/events.py): ONE structured record
+        # per terminal request, joining the trace with token counts,
+        # block-seconds, and ledger-derived cost — the engine is a
+        # producer, so it ARMS the global log (dump paths only peek)
+        self._events = get_wide_event_log()
+        self._cost_ledger = None  # set by register_costs()
         reg = get_registry()
+        self._registry = reg
+        # per-tenant labeled children (consensusml_tenant_*), created
+        # lazily on a tenant's first terminal event and cached — the
+        # registry dedupes by key, the cache just skips its lock
+        self._tenant_children: dict[str, dict[str, Any]] = {}
         self._m_requests = reg.counter(
             "consensusml_serve_requests_total", "requests accepted by submit()"
         )
@@ -386,6 +399,7 @@ class Engine:
         self._block_occupancy_sum = 0.0
         self._decode_steps = 0
         self._tokens_out = 0
+        self._tokens_in = 0  # prompt tokens of first-time admissions
         self._decode_time_s = 0.0
         self._evictions = 0
         self._swaps = 0
@@ -414,6 +428,7 @@ class Engine:
         top_p: float | None = None,
         seed: int | None = None,
         eos_id: int | None = None,
+        tenant: str | None = None,
     ):
         """Enqueue one request; returns a ``RequestHandle``.
 
@@ -430,6 +445,13 @@ class Engine:
         else shares the batch. ``eos_id`` overrides the engine-wide stop
         token per request (the two causal-LM families use different eos
         ids; ``None`` keeps the config default).
+
+        ``tenant`` labels THIS request for per-workload attribution
+        (docs/observability.md "Wide events & tenant accounting"):
+        it rides the trace, the terminal wide event, the
+        ``consensusml_tenant_*`` labeled families, and the echoed
+        ``GenResult``. ``None``/empty means ``"default"``; the label is
+        sanitized at this boundary (obs/events.py).
 
         Raises ``queue.Full`` when the bounded queue is full (with
         ``block=False`` or after ``timeout``) and ``RuntimeError`` once
@@ -475,15 +497,19 @@ class Engine:
                 "engine with a larger ServeConfig.max_len"
             )
         from consensusml_tpu.obs import TraceContext
+        from consensusml_tpu.obs.events import sanitize_tenant
 
+        tenant_s = sanitize_tenant(tenant)
         ctx = trace if trace is not None else TraceContext.mint("srv")
         handle = self._RequestHandle(len(ids))
         req = self._Request(
             list(map(int, ids)), max_new, handle, ctx=ctx,
             temperature=temp, top_p=tp, seed=seed, eos_id=eos,
+            tenant=tenant_s,
         )
         self._rt.start(
-            ctx, len(ids), max_new_tokens=max_new, generation=self._generation
+            ctx, len(ids), max_new_tokens=max_new,
+            generation=self._generation, tenant=tenant_s,
         )
         try:
             self._queue.put(req, block=block, timeout=timeout)
@@ -895,6 +921,7 @@ class Engine:
         out = {
             "kv_impl": self.config.kv_impl,
             "attn_impl": self.attn_impl,
+            "tokens_in": self._tokens_in,
             "tokens_out": self._tokens_out,
             "decode_steps": self._decode_steps,
             "ttft_p50_ms": 1e3 * pct(self._ttfts, 50),
@@ -994,6 +1021,11 @@ class Engine:
             # terminal "cancelled" result instead of a hung handle
             for i, slot in self._table.active:
                 self._table.release(i)
+                if self.paged:
+                    # settle block-seconds for the wide event; the pool
+                    # itself is NOT released here (unchanged: the
+                    # process is exiting, nothing re-admits)
+                    slot.request.block_seconds += self._pool.block_seconds(i)
                 self._finish_handle(
                     slot.request, slot.request.handle._all, "cancelled"
                 )
@@ -1097,6 +1129,10 @@ class Engine:
         # an evicted continuation re-prefills prompt + generated-so-far;
         # its TTFT already happened and its token count keeps running
         already = len(req.handle._all)
+        # every admission's bucket feeds the wide event's cost join —
+        # a continuation re-prefills (a real forward) into a possibly
+        # larger bucket, and each one is paid for
+        req.prefill_buckets.append(bucket)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.ids
         self._rt.event(
@@ -1163,6 +1199,10 @@ class Engine:
             self._m_ttft.observe(ttft, exemplar=rid)
             self._ttfts.append(ttft)
             req.handle._ttft_s = ttft
+            self._tokens_in += n
+            self._tenant_metrics(req.tenant)["ttft"].observe(
+                ttft, exemplar=rid
+            )
         else:  # continuation: the stream's real TTFT already happened
             ttft = getattr(req.handle, "_ttft_s", 0.0)
         req.handle._emit(tok)
@@ -1171,6 +1211,7 @@ class Engine:
         if already + 1 >= req.max_new_tokens or tok == req.eos_id:
             reason = "eos" if tok == req.eos_id else "max_tokens"
             if self.paged:
+                req.block_seconds += self._pool.block_seconds(idx)
                 self._pool.release(idx)
             self._finish_handle(req, req.handle._all, reason, ttft=ttft)
             return
@@ -1196,8 +1237,11 @@ class Engine:
         seeds the continuation's cache and next token, so the client's
         stream continues — tokens already emitted stand, none drop."""
         slot = self._table.release(idx)
-        self._pool.release(idx)
         req = slot.request
+        # settle the hold-time integral before the blocks go back; the
+        # re-admission restarts the clock on a fresh allocation
+        req.block_seconds += self._pool.block_seconds(idx)
+        self._pool.release(idx)
         # req.ids may itself be a continuation; the first prompt_len ids
         # are always the original prompt
         req.ids = list(req.ids[: req.handle.prompt_len]) + list(
@@ -1303,6 +1347,8 @@ class Engine:
         self._rt.decode_ticks(
             [self._rid(slot.request) for _i, slot in active]
         )
+        for _i, slot in active:
+            slot.request.decode_ticks += 1  # wide-event cost join
         for i, slot in active:
             self._emit_and_advance(i, slot, [int(next_toks[i])], now)
 
@@ -1349,6 +1395,7 @@ class Engine:
             if reason is not None:
                 self._table.release(i)
                 if self.paged:
+                    req.block_seconds += self._pool.block_seconds(i)
                     self._pool.release(i)
                 self._finish_handle(
                     req, req.handle._all, reason,
@@ -1427,6 +1474,7 @@ class Engine:
             req = slot.request
             req.spec_proposed += k
             req.spec_accepted += n
+            req.decode_ticks += 1  # one spec round = one resident tick
             round_accepted += n
             spec_rows.append((self._rid(req), k, n))
         self._rt.spec_ticks(spec_rows)
@@ -1466,6 +1514,153 @@ class Engine:
             self._pool.free_blocks * self._block_nbytes
         )
 
+    def _tenant_metrics(self, tenant: str) -> dict[str, Any]:
+        """The ``consensusml_tenant_*`` labeled children for one tenant,
+        created lazily on first touch and cached. Labeled by tenant so
+        PR 14's labeled-children alert matching gives every tenant its
+        OWN burn-rate SLO over ``consensusml_tenant_ttft_seconds`` with
+        zero alert-engine changes (docs/observability.md)."""
+        m = self._tenant_children.get(tenant)
+        if m is not None:
+            return m
+        from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
+
+        reg, labels = self._registry, {"tenant": tenant}
+        m = self._tenant_children[tenant] = {
+            "requests": reg.counter(
+                "consensusml_tenant_requests_total",
+                "terminal requests per tenant (any finish reason)",
+                labels=labels,
+            ),
+            "tokens": reg.counter(
+                "consensusml_tenant_tokens_total",
+                "generated tokens per tenant",
+                labels=labels,
+            ),
+            "tflops": reg.counter(
+                "consensusml_tenant_tflops_total",
+                "ledger-joined TFLOPs consumed per tenant",
+                labels=labels,
+            ),
+            "block_seconds": reg.counter(
+                "consensusml_tenant_block_seconds_total",
+                "KV block-seconds held per tenant (pool hold-time integral)",
+                labels=labels,
+            ),
+            "ttft": reg.histogram(
+                "consensusml_tenant_ttft_seconds",
+                "time to first token per tenant (the per-tenant SLO series)",
+                buckets=DEFAULT_SLO_BUCKETS,
+                labels=labels,
+            ),
+        }
+        return m
+
+    def _request_cost(self, req) -> dict[str, Any]:
+        """Ledger-join one request's device cost: each admission's
+        prefill-bucket row (+ the draft's on a speculative engine), plus
+        ``decode_ticks`` × the per-step row — ``serve.decode`` on the
+        plain path, ``serve.spec.propose + serve.spec.verify`` per
+        round on the speculative path. The base (unsuffixed) rows are
+        registered from the engine's OWN executables, so they price the
+        executed attention tier whatever ``attn_impl`` resolved to.
+        Costs are XLA's cost analysis, never guessed; with no ledger
+        registered the event still emits, zeros + ``cost_joined:
+        False``."""
+        ledger = self._cost_ledger
+        flops = bytes_ = 0.0
+        joined = ledger is not None
+        if ledger is not None:
+            rows = []
+            for b in req.prefill_buckets:
+                rows.append(ledger.row(f"serve.prefill.b{b}"))
+                if self.spec is not None:
+                    rows.append(ledger.row(f"serve.draft_prefill.b{b}"))
+            if self.spec is not None:
+                step_rows = [
+                    ledger.row("serve.spec.propose"),
+                    ledger.row("serve.spec.verify"),
+                ]
+            else:
+                step_rows = [ledger.row("serve.decode")]
+            for row in rows:
+                if row is None:
+                    joined = False
+                    continue
+                flops += row.flops
+                bytes_ += row.bytes_accessed
+            for row in step_rows:
+                if row is None:
+                    joined = False
+                    continue
+                flops += req.decode_ticks * row.flops
+                bytes_ += req.decode_ticks * row.bytes_accessed
+        return {
+            "flops": flops,
+            "hbm_bytes": bytes_,
+            "tflops": flops / 1e12,
+            "cost_joined": joined,
+        }
+
+    _STAGES = ("submit", "admission", "prefill", "decode", "complete")
+
+    def _emit_wide_event(
+        self, req, tokens, reason, ttft, latency, generation
+    ) -> None:
+        """The terminal wide event: ONE record joining the request's
+        trace timings, token counts, pool block-seconds, resolved
+        attention tier, and ledger cost (obs/events.py). Called from
+        :meth:`_finish_handle` so every terminal state — complete,
+        truncated, error-drain — emits exactly once; rejected requests
+        never reach here and emit nothing."""
+        rid = self._rid(req)
+        tr = self._rt.get(rid) if rid else None
+        stages_us: dict[str, float] = {}
+        defer_ticks = preemptions = 0
+        if tr is not None:
+            for ev in tr.events:
+                name = ev.get("name")
+                if name in self._STAGES and name not in stages_us:
+                    stages_us[name] = round(
+                        ev["ts_us"] - tr.t_start_us, 3
+                    )
+            defer_ticks = tr.defer_ticks
+            preemptions = tr.preemptions
+        ev = {
+            "trace_id": getattr(req.ctx, "trace_id", ""),
+            "request_id": rid or "",
+            "tenant": req.tenant,
+            "finish_reason": reason,
+            "prompt_len": req.handle.prompt_len,
+            "tokens_out": len(tokens),
+            "ttft_s": round(ttft, 6) if tokens else None,
+            "latency_s": round(latency, 6),
+            "decode_ticks": req.decode_ticks,
+            "defer_ticks": defer_ticks,
+            "preemptions": preemptions,
+            "generation": generation,
+            "spec_proposed": req.spec_proposed,
+            "spec_accepted": req.spec_accepted,
+            "block_seconds": round(req.block_seconds, 6),
+            "attn_impl": self.attn_impl,
+            "kv_impl": self.config.kv_impl,
+            "prefill_buckets": list(req.prefill_buckets),
+            # stage offsets from submit, µs — the joined trace timeline
+            "stages_us": stages_us,
+        }
+        ev.update(self._request_cost(req))
+        self._events.emit(ev)
+        tm = self._tenant_metrics(req.tenant)
+        tm["requests"].inc()
+        if tokens:
+            tm["tokens"].inc(len(tokens))
+        # consumption is real whatever the finish reason — a cancelled
+        # stream still burned its flops and held its blocks
+        if ev["tflops"] > 0:
+            tm["tflops"].inc(ev["tflops"])
+        if req.block_seconds > 0:
+            tm["block_seconds"].inc(req.block_seconds)
+
     def _finish_handle(
         self, req, tokens, reason: str, ttft: float = 0.0,
         generation: int | None = None,
@@ -1475,6 +1670,7 @@ class Engine:
         now = time.perf_counter()
         latency = now - req.arrival_t
         ctx = req.ctx
+        gen = self._generation if generation is None else generation
         req.handle._finish(
             GenResult(
                 tokens=list(tokens),
@@ -1482,9 +1678,7 @@ class Engine:
                 ttft_s=ttft,
                 latency_s=latency,
                 prompt_len=req.handle.prompt_len,
-                generation=(
-                    self._generation if generation is None else generation
-                ),
+                generation=gen,
                 trace_id=getattr(ctx, "trace_id", ""),
                 request_id=getattr(ctx, "request_id", ""),
                 temperature=req.temperature,
@@ -1492,6 +1686,8 @@ class Engine:
                 seed=req.seed,
                 spec_proposed=req.spec_proposed,
                 spec_accepted=req.spec_accepted,
+                tenant=req.tenant,
+                block_seconds=req.block_seconds,
             )
         )
         self._rt.finish(
@@ -1499,6 +1695,9 @@ class Engine:
             tokens=len(tokens), ttft_s=round(ttft, 6),
             latency_s=round(latency, 6),
         )
+        # the wide event reads the COMPLETED trace (the registry resolves
+        # finished ids while the done-ring holds them), so emit after
+        self._emit_wide_event(req, tokens, reason, ttft, latency, gen)
         if reason != "cancelled":
             self._m_completed.inc()
 

@@ -40,7 +40,8 @@ exactly this).
 
 from __future__ import annotations
 
-from typing import Any
+import time
+from typing import Any, Callable
 
 import numpy as np
 
@@ -92,6 +93,7 @@ class BlockPool:
         max_len: int,
         block_size: int,
         num_blocks: int = 0,
+        clock: Callable[[], float] | None = None,
     ):
         if block_size < 1:
             raise ValueError(f"block_size must be positive, got {block_size}")
@@ -118,6 +120,15 @@ class BlockPool:
         self._owned: dict[int, list[int]] = {}
         self._table = np.zeros((num_slots, self.blocks_per_slot), np.int32)
         self._dev_table = None  # invalidated on mutation, rebuilt lazily
+        # block-second accounting (docs/observability.md "Wide events &
+        # tenant accounting"): per-slot ∫ held_blocks dt, integrated at
+        # every mutation — each alloc/extend/shrink/release first adds
+        # held × elapsed at the OLD holding, then mutates, so the
+        # integral is exact piecewise-constant occupancy over hold time.
+        # The clock is injectable so tests pin the math deterministically.
+        self._clock = clock if clock is not None else time.monotonic
+        self._bs_acc: dict[int, float] = {}
+        self._bs_t: dict[int, float] = {}
 
     # -- introspection ------------------------------------------------------
 
@@ -139,6 +150,27 @@ class BlockPool:
     def can_admit(self, n_blocks: int) -> bool:
         return len(self._free) >= n_blocks
 
+    def _integrate(self, slot: int) -> None:
+        """Advance ``slot``'s block-second integral to now at its
+        CURRENT holding (call before any mutation of the holding)."""
+        t = self._bs_t.get(slot)
+        if t is None:
+            return
+        now = self._clock()
+        self._bs_acc[slot] += len(self._owned.get(slot, ())) * (now - t)
+        self._bs_t[slot] = now
+
+    def block_seconds(self, slot: int) -> float:
+        """``slot``'s block-seconds held so far (∫ owned_blocks dt since
+        its alloc, integrated to now). 0.0 for a slot that owns nothing
+        — the engine reads this immediately BEFORE :meth:`release` and
+        accumulates it onto the request, so the total survives
+        recompute-preemption and re-admission."""
+        if slot not in self._owned:
+            return 0.0
+        self._integrate(slot)
+        return self._bs_acc.get(slot, 0.0)
+
     # -- mutation -----------------------------------------------------------
 
     def alloc(self, slot: int, n_blocks: int) -> list[int]:
@@ -158,6 +190,8 @@ class BlockPool:
                 f"need {n_blocks} blocks, {len(self._free)} free"
             )
         self._owned[slot] = []
+        self._bs_acc[slot] = 0.0
+        self._bs_t[slot] = self._clock()
         return self.extend(slot, n_blocks)
 
     def extend(self, slot: int, n_blocks: int = 1) -> list[int]:
@@ -174,6 +208,7 @@ class BlockPool:
             raise NoFreeBlocks(
                 f"need {n_blocks} blocks, {len(self._free)} free"
             )
+        self._integrate(slot)
         got = []
         for _ in range(n_blocks):
             b = self._free.pop()
@@ -197,6 +232,7 @@ class BlockPool:
                 f"keep_blocks must be >= 1, got {keep_blocks} (release() "
                 "frees a slot outright)"
             )
+        self._integrate(slot)
         freed = []
         while len(owned) > keep_blocks:
             b = owned.pop()
@@ -215,6 +251,8 @@ class BlockPool:
         owned = self._owned.pop(slot, None)
         if owned is None:
             raise RuntimeError(f"slot {slot} owns nothing (double-free)")
+        self._bs_acc.pop(slot, None)
+        self._bs_t.pop(slot, None)
         for b in owned:
             if b == TRASH_BLOCK or b in self._free:
                 raise RuntimeError(f"corrupt free list: block {b}")

@@ -7,22 +7,26 @@ request (one line; ``trace_id``/``request_id`` are optional — anything
 missing is minted server-side, so every request is traceable; the
 sampling triple ``temperature``/``top_p``/``seed`` and the per-request
 ``eos_id`` stop override are optional too — omitted fields take the
-engine's ``ServeConfig`` defaults)::
+engine's ``ServeConfig`` defaults; ``tenant`` labels the request for
+per-workload attribution, ``"default"`` when omitted)::
 
     {"ids": [3, 17, 42], "max_new_tokens": 16,
      "temperature": 0.8, "top_p": 0.95, "seed": 12345, "eos_id": 50256,
+     "tenant": "batch-eval",
      "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
 response (streamed, one line per token, then a terminal record echoing
-the trace identity AND the resolved sampling triple — resubmitting with
-the echoed seed replays the exact token stream)::
+the trace identity, the resolved sampling triple — resubmitting with
+the echoed seed replays the exact token stream — and the RESOLVED
+tenant label, sanitized server-side, that the request's wide event and
+``consensusml_tenant_*`` series carry)::
 
     {"token": 7}
     {"token": 19}
     {"done": true, "tokens": [7, 19, ...], "finish_reason": "max_tokens",
      "ttft_ms": 12.3, "latency_ms": 48.9,
      "temperature": 0.8, "top_p": 0.95, "seed": 12345,
-     "spec_proposed": 12, "spec_accepted": 9,
+     "spec_proposed": 12, "spec_accepted": 9, "tenant": "batch-eval",
      "trace_id": "lg0-00042", "request_id": "lg0-00042/0"}
 
 errors land as ``{"error": "..."}`` and close the connection. One
@@ -58,6 +62,7 @@ class ServeServer:
     the live observability endpoints — ``/metrics`` Prometheus text,
     ``/traces`` merged Chrome trace, ``/requests`` request-trace
     snapshot, ``/alerts`` + ``/query`` + ``/healthz`` from the alert
+    plane, ``/events`` + ``/tenants`` from the wide-event accounting
     plane — from :class:`consensusml_tpu.obs.MetricsServer`; read the
     bound address back from :attr:`metrics_address`. A serving process
     has no train loop to drive telemetry ticks, so the metrics server's
@@ -165,6 +170,7 @@ class ServeServer:
                         top_p=req.get("top_p"),
                         seed=req.get("seed"),
                         eos_id=req.get("eos_id"),
+                        tenant=req.get("tenant"),
                     )
                 except Exception as e:  # bad JSON, validation, draining
                     f.write(json.dumps({"error": str(e)}).encode() + b"\n")
@@ -187,6 +193,7 @@ class ServeServer:
                             "seed": r.seed,
                             "spec_proposed": r.spec_proposed,
                             "spec_accepted": r.spec_accepted,
+                            "tenant": r.tenant,
                             "trace_id": r.trace_id,
                             "request_id": r.request_id,
                         }

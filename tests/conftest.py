@@ -139,6 +139,21 @@ _SLOW_TESTS = {
     # covered by them at engine level and pin secondary surfaces.
     "test_register_costs_adds_fused_rows_side_by_side",
     "test_model_decode_step_parity_per_family",
+    # round-7 re-tier: fast tier re-measured at ~17 min on this box, over
+    # the verify budget. Tests >=10s with a fast-tier sibling or e2e
+    # covering the same surface move here. The acceptance-critical set
+    # (paged-vs-slot parity [gpt2], fused stream/spec parity both
+    # families, zero-recompile contract, hot-swap e2e, wide-event
+    # cost-join pin + multi-tenant e2e) deliberately STAYS fast.
+    "test_sampled_engine_streams_replay_deterministically",
+    "test_tight_pool_preempts_mid_draft_stream_by_recompute",
+    "test_close_from_another_thread_unblocks_waiting_consumer",
+    "test_cli_all_exits_zero_on_repo",
+    "test_llama_loss_fn_parity",
+    "test_perf_sweep_fed_input_smoke",
+    "test_profile_endpoint_single_flight_and_rotation",
+    "test_profile_capture_parses_via_xprof_summary_json",
+    "test_engine_without_ledger_still_emits_unjoined",
 }
 
 

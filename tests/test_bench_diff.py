@@ -74,6 +74,48 @@ def test_default_spec_is_well_formed():
         assert dirs == {"down", "max"}, key
     assert "attribution.compile_ms.serve_decode_fused" in keys
     assert "attribution.compile_ms.spec_verify_fused" in keys
+    # the wide-event accounting plane (ISSUE 17): per-terminal emit
+    # overhead budget plus the rollup-must-balance gate
+    assert "observability.wide_event_overhead_pct" in keys
+    assert "observability.tenant_rollup_mismatch" in keys
+
+
+def test_wide_event_gates_enforced_on_fresh_result(tmp_path, capsys):
+    """A fresh bench whose wide-event plane blows the emit budget or
+    whose rollup fails to re-derive the engine totals fails; the
+    healthy shape passes."""
+    mod = _tool()
+    fresh = {
+        "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+        "observability": {
+            "wide_event_overhead_pct": 3.2,
+            "tenant_rollup_mismatch": 4,
+        },
+    }
+    path = tmp_path / "fresh.json"
+    path.write_text(json.dumps(fresh))
+    rc = mod.main([str(path), "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "observability.wide_event_overhead_pct" in failed
+    assert "observability.tenant_rollup_mismatch" in failed
+
+    healthy = {
+        "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+        "observability": {
+            "wide_event_overhead_pct": 0.04,
+            "tenant_rollup_mismatch": 0,
+        },
+    }
+    path2 = tmp_path / "healthy.json"
+    path2.write_text(json.dumps(healthy))
+    rc = mod.main([str(path2), "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    ok = {r["key"]: r["status"] for r in doc["rows"]}
+    assert ok["observability.wide_event_overhead_pct"] == "ok"
+    assert ok["observability.tenant_rollup_mismatch"] == "ok"
 
 
 def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):

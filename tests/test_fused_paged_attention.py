@@ -203,7 +203,13 @@ def test_model_decode_step_parity_per_family(family):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize(
+    # ~25s/family on this box (round-7 re-tier): gpt2 keeps the fused-vs-
+    # gather engine-stream parity axis fast; llama rides the slow tier —
+    # its fused path stays fast-covered by the spec-engine parity test.
+    "family",
+    ["gpt2", pytest.param("llama", marks=pytest.mark.slow)],
+)
 def test_fused_engine_streams_match_gather(family):
     """The SAME prompts — half greedy, half sampled — served under every
     attn tier produce identical token streams with zero recompiles

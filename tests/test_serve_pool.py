@@ -237,7 +237,13 @@ def test_paged_stages_bitexact_vs_slot_path(family):
         )
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize(
+    # ~60s/family on this box; gpt2 keeps the paged-vs-slot-vs-full parity
+    # axis in the fast tier, llama rides the slow tier (its paged path is
+    # still exercised fast by the fused-vs-gather stream parity test).
+    "family",
+    ["gpt2", pytest.param("llama", marks=pytest.mark.slow)],
+)
 def test_paged_engine_matches_slot_engine_and_full_forward(family):
     """Engine-level parity: the SAME prompts greedily decoded through the
     paged engine, the per-slot engine, and a full-causal-forward loop

@@ -1,0 +1,616 @@
+"""Wide-event request accounting (ISSUE 17): the per-request cost join,
+tenant attribution, rollups, and per-tenant SLOs.
+
+Acceptance anchors:
+
+- **The join balances** — every terminal request emits exactly ONE wide
+  event whose cost is the ledger's own rows (``decode_ticks`` × the
+  per-step row + one prefill-bucket row per admission), whose timings
+  are the request trace's own events, and whose block-seconds are the
+  pool's hold-time integral; per-tenant rollups re-derive the engine's
+  own totals.
+- **Tenant SLOs ride PR 14 unchanged** — the engine's labeled
+  ``consensusml_tenant_ttft_seconds`` children give every tenant its own
+  burn-rate rule via the alert engine's labeled-children matching, and
+  a burst on one tenant fires ONLY that tenant's alert.
+- **E2E** — multi-tenant socket loadgen → ServeServer → paged engine
+  over a 10-block pool (structural recompute-preemption) with a
+  mid-traffic hot swap: every wide event joins its trace by trace_id,
+  the ``/events``/``/tenants`` endpoints serve the log, and the cluster
+  aggregate + obs_report carry the per-tenant table (absent, not
+  broken, on pre-wide-event snapshot directories).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consensusml_tpu.obs import (
+    AlertRule,
+    ClusterWriter,
+    FlightRecorder,
+    MetricsHistory,
+    MetricsRegistry,
+    MetricsServer,
+    RequestTraceRegistry,
+    SloSpec,
+    SpanTracer,
+    TraceContext,
+    aggregate,
+    get_registry,
+    get_request_registry,
+)
+from consensusml_tpu.obs import events as events_mod
+from consensusml_tpu.obs import metrics as metrics_mod
+from consensusml_tpu.obs import requests as requests_mod
+from consensusml_tpu.obs.alerts import AlertEngine
+from consensusml_tpu.obs.events import (
+    WORST_TTFT_KEEP,
+    WideEventLog,
+    get_wide_event_log,
+    peek_wide_event_log,
+    reset_wide_event_log,
+    sanitize_tenant,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.serving]
+
+
+def _tiny_gpt2(max_len=32):
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=max_len,
+            dropout=0.0,
+        )
+    )
+
+
+def _init(model, seq=8, seed=0):
+    return model.init(
+        jax.random.key(seed), jnp.zeros((1, seq), jnp.int32)
+    )["params"]
+
+
+def _fresh_obs(monkeypatch):
+    """Fresh process-wide registries + wide-event log: earlier in-process
+    serving runs must not leak events into these assertions."""
+    monkeypatch.setattr(metrics_mod, "_GLOBAL", MetricsRegistry())
+    monkeypatch.setattr(requests_mod, "_GLOBAL", RequestTraceRegistry())
+    reset_wide_event_log()
+
+
+# ---------------------------------------------------------------------------
+# tenant label + log semantics
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_tenant_boundary():
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("") == "default"
+    assert sanitize_tenant("batch-eval.v2_A") == "batch-eval.v2_A"
+    # untrusted line-JSON input: charset enforced, once, at the boundary
+    assert sanitize_tenant("a b/c{d}") == "a_b_c_d_"
+    assert sanitize_tenant(42) == "42"
+    assert len(sanitize_tenant("x" * 200)) == 64
+
+
+def test_log_ring_bound_jsonl_sink_and_filters(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = WideEventLog(capacity=4, jsonl_path=path)
+    for i in range(10):
+        log.emit({"tenant": "a" if i % 2 else "b", "i": i,
+                  "bad": float("nan")})
+    assert len(log) == 4  # ring bound: oldest dropped
+    assert log.emitted_total == 10
+    assert [e["i"] for e in log.events()] == [6, 7, 8, 9]  # newest-last
+    assert [e["i"] for e in log.events(2)] == [8, 9]
+    assert [e["i"] for e in log.events(tenant="a")] == [7, 9]
+    assert log.tenants() == ["a", "b"]
+    # every emitted event is stamped and JSON-safe
+    for e in log.events():
+        assert e["bad"] is None and e["time_s"] > 0
+    # the sink holds the FULL history, one strict-JSON line per event
+    log.close()
+    with open(path) as f:
+        lines = [json.loads(x) for x in f]
+    assert len(lines) == 10
+    assert all(ln.get("bad") is None for ln in lines)
+    with pytest.raises(ValueError):
+        WideEventLog(capacity=0)
+
+
+def test_rollup_aggregates_and_worst_ttft_cap():
+    log = WideEventLog()
+    for i in range(12):
+        log.emit({
+            "tenant": "t0", "prompt_len": 4, "tokens_out": 8,
+            "tflops": 0.5, "hbm_bytes": 2e9, "block_seconds": 0.25,
+            "decode_ticks": 8, "defer_ticks": 1, "preemptions": i % 2,
+            "ttft_s": 0.01 * (i + 1), "request_id": f"r{i}",
+            "trace_id": f"tr{i}",
+        })
+    log.emit({"tenant": "t1", "prompt_len": 2, "tokens_out": 0,
+              "ttft_s": None})
+    roll = log.rollup()
+    t0 = roll["t0"]
+    assert t0["requests"] == 12
+    assert t0["tokens_in"] == 48 and t0["tokens_out"] == 96
+    assert t0["tflops"] == pytest.approx(6.0)
+    assert t0["hbm_gbytes"] == pytest.approx(24.0)
+    assert t0["block_seconds"] == pytest.approx(3.0)
+    assert t0["decode_ticks"] == 96 and t0["defer_ticks"] == 12
+    assert t0["preemptions"] == 6
+    # worst-first, capped like the exemplar rings
+    worst = t0["worst_ttft"]
+    assert len(worst) == WORST_TTFT_KEEP
+    assert worst[0]["request_id"] == "r11"
+    assert [w["ttft_s"] for w in worst] == sorted(
+        (w["ttft_s"] for w in worst), reverse=True
+    )
+    # a zero-token terminal (no first token) contributes no TTFT sample
+    assert roll["t1"]["worst_ttft"] == []
+    snap = log.snapshot(last_n=3)
+    assert snap["emitted_total"] == 13 and snap["retained"] == 13
+    assert len(snap["events_recent"]) == 3
+    assert set(snap["tenants"]) == {"t0", "t1"}
+
+
+def test_singleton_arm_peek_reset(monkeypatch, tmp_path):
+    reset_wide_event_log()
+    assert peek_wide_event_log() is None  # a dump must not create one
+    path = str(tmp_path / "sink.jsonl")
+    monkeypatch.setenv("CONSENSUSML_WIDE_EVENTS_JSONL", path)
+    log = get_wide_event_log()
+    assert peek_wide_event_log() is log
+    assert get_wide_event_log() is log
+    log.emit({"tenant": "env"})
+    assert os.path.exists(path)  # env-configured durable sink
+    reset_wide_event_log()
+    assert peek_wide_event_log() is None
+
+
+# ---------------------------------------------------------------------------
+# pool block-seconds: the hold-time integral, deterministic clock
+# ---------------------------------------------------------------------------
+
+
+def test_block_pool_block_seconds_deterministic_clock():
+    from consensusml_tpu.serve import pool as P
+
+    now = [0.0]
+    pool = P.BlockPool(
+        num_slots=2, max_len=32, block_size=8, clock=lambda: now[0]
+    )
+    pool.alloc(0, 2)  # 2 blocks held from t=0
+    now[0] = 1.0
+    assert pool.block_seconds(0) == pytest.approx(2.0)
+    pool.extend(0, 1)  # 3 blocks from t=1
+    now[0] = 3.0
+    assert pool.block_seconds(0) == pytest.approx(2.0 + 3 * 2.0)
+    pool.shrink(0, 1)  # 1 block from t=3
+    now[0] = 4.0
+    assert pool.block_seconds(0) == pytest.approx(8.0 + 1.0)
+    # a second slot integrates independently
+    pool.alloc(1, 1)
+    now[0] = 6.0
+    assert pool.block_seconds(1) == pytest.approx(2.0)
+    assert pool.block_seconds(0) == pytest.approx(8.0 + 3.0)
+    pool.release(0)
+    assert pool.block_seconds(0) == 0.0  # settled out with the release
+    assert pool.block_seconds(1) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# engine emission: one event per terminal, ledger-joined
+# ---------------------------------------------------------------------------
+
+
+def test_engine_emits_joined_events_and_tenant_series(monkeypatch):
+    from consensusml_tpu.obs import CostLedger
+    from consensusml_tpu.serve import Engine, ServeConfig
+
+    _fresh_obs(monkeypatch)
+    reg = get_registry()
+    model = _tiny_gpt2()
+    params = _init(model)
+    led = CostLedger(registry=MetricsRegistry())
+    with Engine(
+        model, params, ServeConfig(num_slots=4, max_len=32, max_new_tokens=8)
+    ) as eng:
+        eng.warmup()
+        eng.register_costs(led)
+        handles = [
+            eng.submit(
+                [1 + i % 30] * (3 + i % 7),
+                tenant=("alpha", "beta")[i % 2],
+                trace=TraceContext(f"we-{i}"),
+            )
+            for i in range(6)
+        ]
+        results = [h.result(timeout=300) for h in handles]
+        stats = eng.stats()
+    log = peek_wide_event_log()
+    assert log is not None and log.emitted_total == 6
+    events = log.events()
+    decode_row = led.row("serve.decode")
+    by_rid = {e["request_id"]: e for e in events}
+    for i, (h, r) in enumerate(zip(handles, results)):
+        ev = by_rid[f"we-{i}/0"]
+        assert ev["trace_id"] == f"we-{i}"
+        assert ev["tenant"] == ("alpha", "beta")[i % 2]
+        assert ev["finish_reason"] == r.finish_reason
+        assert ev["tokens_out"] == len(r.tokens)
+        assert ev["prompt_len"] == 3 + i % 7
+        assert ev["ttft_s"] == pytest.approx(r.ttft_s, abs=1e-5)
+        assert ev["latency_s"] == pytest.approx(r.latency_s, abs=1e-5)
+        assert ev["generation"] == r.generation
+        # the joined trace timeline: every stage offset present, ordered
+        st = ev["stages_us"]
+        for stage in ("submit", "admission", "prefill", "decode",
+                      "complete"):
+            assert stage in st, (ev["request_id"], st)
+        assert st["submit"] <= st["admission"] <= st["prefill"]
+        assert st["prefill"] <= st["decode"] <= st["complete"]
+        # the cost join is the ledger's OWN rows, exactly
+        assert ev["cost_joined"] is True
+        expected_flops = ev["decode_ticks"] * decode_row.flops + sum(
+            led.row(f"serve.prefill.b{b}").flops
+            for b in ev["prefill_buckets"]
+        )
+        assert ev["flops"] == pytest.approx(expected_flops)
+        assert ev["tflops"] == pytest.approx(expected_flops / 1e12)
+        assert ev["hbm_bytes"] > 0
+        assert 0 < ev["decode_ticks"] <= len(r.tokens)
+    # stats carries prompt-side totals; the rollup re-derives both
+    assert stats["tokens_in"] == sum(3 + i % 7 for i in range(6))
+    roll = log.rollup()
+    assert sum(a["tokens_in"] for a in roll.values()) == stats["tokens_in"]
+    assert sum(a["tokens_out"] for a in roll.values()) == stats["tokens_out"]
+    assert sum(a["requests"] for a in roll.values()) == 6
+    # the labeled per-tenant families landed in the process registry
+    m = reg.snapshot()["metrics"]
+    assert m['consensusml_tenant_requests_total{tenant="alpha"}'] == 3.0
+    assert m['consensusml_tenant_requests_total{tenant="beta"}'] == 3.0
+    assert m['consensusml_tenant_tokens_total{tenant="alpha"}'] == sum(
+        len(r.tokens) for i, r in enumerate(results) if i % 2 == 0
+    )
+    assert m['consensusml_tenant_tflops_total{tenant="alpha"}'] > 0
+    assert 'consensusml_tenant_ttft_seconds{tenant="beta"}' in m
+
+
+def test_engine_without_ledger_still_emits_unjoined(monkeypatch):
+    from consensusml_tpu.serve import Engine, ServeConfig
+
+    _fresh_obs(monkeypatch)
+    model = _tiny_gpt2()
+    with Engine(
+        model, _init(model),
+        ServeConfig(num_slots=2, max_len=32, max_new_tokens=4),
+    ) as eng:
+        eng.warmup()
+        eng.submit([1, 2, 3], tenant="solo").result(timeout=300)
+    (ev,) = peek_wide_event_log().events()
+    assert ev["cost_joined"] is False
+    assert ev["flops"] == 0.0 and ev["tflops"] == 0.0
+    assert ev["tenant"] == "solo" and ev["tokens_out"] == 4
+
+
+# ---------------------------------------------------------------------------
+# surfacing: /events + /tenants, flight dump, cluster aggregate
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    return json.loads(urllib.request.urlopen(url).read().decode())
+
+
+def test_httpd_events_endpoints(monkeypatch):
+    _fresh_obs(monkeypatch)
+    reg = MetricsRegistry()
+    with MetricsServer(registry=reg) as ms:
+        base = f"http://{ms.address[0]}:{ms.address[1]}"
+        # un-armed: enabled=False, never created as a scrape side effect
+        doc = _get_json(base + "/events")
+        assert doc == {"enabled": False, "events": [],
+                       "emitted_total": 0}
+        assert peek_wide_event_log() is None
+        assert _get_json(base + "/tenants") == {
+            "enabled": False, "tenants": {},
+        }
+        log = get_wide_event_log()  # the producer arms it
+        for i in range(5):
+            log.emit({"tenant": "a" if i < 3 else "b", "i": i,
+                      "tokens_out": 2})
+        doc = _get_json(base + "/events?n=2")
+        assert doc["enabled"] is True and doc["emitted_total"] == 5
+        assert [e["i"] for e in doc["events"]] == [3, 4]
+        doc = _get_json(base + "/events?tenant=a")
+        assert [e["i"] for e in doc["events"]] == [0, 1, 2]
+        doc = _get_json(base + "/tenants")
+        assert doc["tenants"]["a"]["requests"] == 3
+        assert doc["tenants"]["b"]["tokens_out"] == 4
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(base + "/events?n=zap")
+        assert err.value.code == 400
+
+
+def test_flight_dump_embeds_wide_events(tmp_path, monkeypatch):
+    _fresh_obs(monkeypatch)
+    # a custom-registry recorder must NOT embed the global plane
+    rec = FlightRecorder(str(tmp_path / "iso"), registry=MetricsRegistry())
+    get_wide_event_log().emit({"tenant": "t", "tokens_out": 1})
+    doc = json.load(open(rec.dump("unit-test")))
+    assert "wide_events" not in doc
+    # a global-registry recorder peeks the armed log at dump time
+    rec2 = FlightRecorder(str(tmp_path / "glob"))
+    doc = json.load(open(rec2.dump("unit-test")))
+    we = doc["wide_events"]
+    assert we["emitted_total"] == 1
+    assert we["tenants"]["t"]["requests"] == 1
+    # explicit wiring wins over the peek
+    other = WideEventLog()
+    other.emit({"tenant": "x"})
+    other.emit({"tenant": "x"})
+    rec3 = FlightRecorder(str(tmp_path / "wired"), events=other)
+    doc = json.load(open(rec3.dump("unit-test")))
+    assert doc["wide_events"]["emitted_total"] == 2
+
+
+def test_cluster_aggregate_merges_tenants(tmp_path, monkeypatch):
+    _fresh_obs(monkeypatch)
+    log = get_wide_event_log()
+    # rank 0 sees tenants a+b, rank 1 (a disjoint engine's log) only a
+    for i in range(4):
+        log.emit({"tenant": "a" if i % 2 else "b", "prompt_len": 2,
+                  "tokens_out": 3, "tflops": 0.1, "block_seconds": 0.5,
+                  "ttft_s": 0.01 * (i + 1), "request_id": f"r0-{i}"})
+    # default-registry writers peek the armed global log at write time
+    ClusterWriter(str(tmp_path), rank=0).write()
+    other = WideEventLog()
+    other.emit({"tenant": "a", "prompt_len": 8, "tokens_out": 1,
+                "tflops": 0.4, "block_seconds": 1.0, "ttft_s": 0.5,
+                "request_id": "r1-0"})
+    ClusterWriter(str(tmp_path), rank=1, events=other).write()
+    doc = aggregate(str(tmp_path))
+    tn = doc["tenants"]
+    assert tn["ranks_reporting"] == 2 and tn["events_total"] == 5
+    a = tn["tenants"]["a"]
+    assert a["requests"] == 3  # 2 from rank 0 + 1 from rank 1
+    assert a["tokens_in"] == 2 * 2 + 8
+    assert a["tflops"] == pytest.approx(0.2 + 0.4)
+    assert a["block_seconds"] == pytest.approx(0.5 * 2 + 1.0)
+    # merged worst-TTFT re-sorted across ranks, worst first
+    assert a["worst_ttft"][0]["request_id"] == "r1-0"
+    assert tn["tenants"]["b"]["requests"] == 2
+
+
+def test_cluster_aggregate_tenants_absent_on_old_snapshots(tmp_path,
+                                                           monkeypatch):
+    """Pre-wide-event snapshot directories aggregate and render with the
+    tenant plane marked absent — never broken."""
+    _fresh_obs(monkeypatch)
+    ClusterWriter(str(tmp_path), rank=0, registry=get_registry()).write()
+    doc = aggregate(str(tmp_path))
+    assert doc["tenants"] is None
+    mod = _obs_report()
+    text = mod.render_text(doc)
+    assert "tenants: absent (no snapshot carries wide-event accounting)" \
+        in text
+
+
+def _obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# loadgen: weighted tenant mix
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenant_weights():
+    from tools.loadgen import parse_tenant_weights
+
+    assert parse_tenant_weights(None) is None
+    assert parse_tenant_weights("a=3,b=1") == [("a", 3.0), ("b", 1.0)]
+    # bare names weight 1; labels sanitized at the boundary
+    assert parse_tenant_weights("batch, bad name=2") == [
+        ("batch", 1.0), ("bad_name", 2.0),
+    ]
+    with pytest.raises(ValueError):
+        parse_tenant_weights("a=0")
+    with pytest.raises(ValueError):
+        parse_tenant_weights(",")
+    with pytest.raises(ValueError):
+        parse_tenant_weights("a=x")
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: multi-tenant loadgen -> server -> paged engine
+# ---------------------------------------------------------------------------
+
+
+class _StubWatcher:
+    """One staged swap, engine-thread protocol only (take/reject/stop)."""
+
+    def __init__(self, staged):
+        self._staged = [staged]
+
+    def take(self):
+        return self._staged.pop() if self._staged else None
+
+    def reject(self, staged=None):  # pragma: no cover - mismatch path
+        raise AssertionError("same-tree swap must not be rejected")
+
+    def stop(self):
+        pass
+
+
+def test_e2e_multitenant_join_rollup_and_tenant_slo(tmp_path, monkeypatch):
+    """The acceptance round-trip: a weighted two-tenant socket loadgen
+    drives a ServeServer over a 10-block paged pool (structural
+    recompute-preemption) with a mid-traffic hot swap. Every wide event
+    joins its completed trace by trace_id; the rollup re-derives the
+    engine totals; the endpoints serve the log; and a TTFT burst on ONE
+    tenant fires only that tenant's burn-rate alert through the stock
+    labeled-children matching."""
+    from consensusml_tpu.serve import Engine, ServeConfig, ServeServer
+    from consensusml_tpu.serve.pool.hotswap import StagedSwap
+    from tools.loadgen import _socket_submit, parse_tenant_weights, \
+        run_loadgen
+
+    _fresh_obs(monkeypatch)
+    rt = get_request_registry()
+    reg = get_registry()
+    model = _tiny_gpt2()
+    params = _init(model)
+    # 10 blocks cannot hold 4 full streams -> recompute-preemption fires
+    engine = Engine(
+        model, params,
+        ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", block_size=8,
+            num_blocks=10, max_new_tokens=8,
+        ),
+    )
+    server = ServeServer(engine, metrics_port=0)
+    try:
+        engine.warmup()
+        host, port = server.address
+        report = run_loadgen(
+            _socket_submit(host, port),
+            n_requests=10, rate_rps=300.0, prompt_lens=(4, 16),
+            vocab=64, max_new_tokens=8, seed=3,
+            tenants=parse_tenant_weights("batch=3,interactive=1"),
+        )
+        assert report["errors"] == 0 and report["completed"] == 10
+        # the client-side report attributes per tenant, echoing the
+        # server-resolved label
+        tn = report["tenants"]
+        assert set(tn) == {"batch", "interactive"}
+        assert sum(t["completed"] for t in tn.values()) == 10
+        for t in tn.values():
+            if t["completed"]:
+                assert t["ttft_p99_ms"] > 0
+
+        # induce a drain-free hot swap under live tenant streams
+        long_handles = [
+            engine.submit([7, 8, 9, 10], max_new_tokens=16,
+                          trace=TraceContext(f"swp-{i}"), tenant="batch")
+            for i in range(3)
+        ]
+        deadline = time.monotonic() + 60
+        while engine._table.num_active < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert engine._table.num_active >= 3
+        engine._watcher = _StubWatcher(
+            StagedSwap(generation=2, params=engine._params, meta={})
+        )
+        results = [h.result(timeout=120) for h in long_handles]
+        assert engine.generation == 2
+        assert all(r.tenant == "batch" for r in results)
+        stats = engine.stats()
+        assert stats["evictions"] > 0
+
+        # live endpoints on the serving side
+        mhost, mport = server.metrics_address
+        doc = _get_json(f"http://{mhost}:{mport}/events?n=100")
+        assert doc["enabled"] is True and doc["emitted_total"] == 13
+        doc = _get_json(f"http://{mhost}:{mport}/tenants")
+        assert set(doc["tenants"]) <= {"batch", "interactive"}
+    finally:
+        server.shutdown(drain=True)
+
+    log = peek_wide_event_log()
+    events = log.events()
+    assert len(events) == 13  # one per terminal, rejected emit nothing
+
+    # ---- every wide event joins its completed trace by trace_id ---------
+    done = {tr.request_id: tr for tr in rt.completed()}
+    for ev in events:
+        tr = done[ev["request_id"]]
+        assert ev["trace_id"] == tr.trace_id
+        assert ev["tenant"] == tr.tenant
+        assert ev["decode_ticks"] == tr.decode_ticks
+        assert ev["defer_ticks"] == tr.defer_ticks
+        assert ev["preemptions"] == tr.preemptions
+        assert ev["kv_impl"] == "paged"
+        st = ev["stages_us"]
+        assert st["submit"] <= st["admission"] <= st["complete"]
+    # the induced pressure landed in the events, not just the stats
+    assert sum(e["preemptions"] for e in events) > 0
+    preempted = [e for e in events if e["preemptions"]]
+    for ev in preempted:  # re-admission re-prefills: bucket per admit
+        assert len(ev["prefill_buckets"]) >= 2
+    assert any(e["generation"] == 2 for e in events)
+    assert all(e["block_seconds"] > 0 for e in events)
+
+    # ---- the rollup re-derives the engine totals ------------------------
+    roll = log.rollup()
+    assert sum(a["requests"] for a in roll.values()) == 13
+    assert sum(a["tokens_out"] for a in roll.values()) == stats["tokens_out"]
+    assert sum(a["tokens_in"] for a in roll.values()) == stats["tokens_in"]
+    assert sum(a["preemptions"] for a in roll.values()) == sum(
+        e["preemptions"] for e in events
+    )
+
+    # ---- per-tenant burn-rate SLO through the stock alert engine --------
+    # the engine's labeled TTFT children exist for every seen tenant;
+    # ONE rule over the family covers them all (PR 14 labeled-children
+    # matching), and a burst on "interactive" pages only "interactive"
+    fam = "consensusml_tenant_ttft_seconds"
+    hist = MetricsHistory(reg, keep=16)
+    rule = AlertRule(
+        "tenant-ttft-burn", fam, kind="burn_rate", severity="page",
+        slo=SloSpec(fam, threshold_s=0.1, objective=0.95),
+        fast_window_s=60.0, slow_window_s=300.0, burn_factor=4.0,
+    )
+    eng = AlertEngine(hist, rules=[rule], registry=reg,
+                      tracer=SpanTracer(), quiet=True)
+    hist.record(now=0.0)
+    assert eng.evaluate(now=0.0) == []
+    burst = engine._tenant_metrics("interactive")["ttft"]
+    calm = engine._tenant_metrics("batch")["ttft"]
+    for _ in range(15):
+        calm.observe(0.01)  # healthy tenant: all under threshold
+        burst.observe(0.01)
+    for _ in range(5):
+        burst.observe(0.4)  # the burst: 5/20 over -> burn 5x > factor 4
+    hist.record(now=60.0)
+    firing = eng.evaluate(now=60.0)
+    assert len(firing) == 1
+    assert firing[0]["series"] == fam + '{tenant="interactive"}'
+
+    # ---- fleet merge + report render ------------------------------------
+    obs_dir = tmp_path / "obs"
+    ClusterWriter(str(obs_dir), rank=0, role="serve").write(
+        extra={"request_traces": rt.snapshot()}
+    )
+    doc = aggregate(str(obs_dir))
+    agg = doc["tenants"]
+    assert agg["events_total"] == 13
+    assert sum(
+        a["requests"] for a in agg["tenants"].values()
+    ) == 13
+    mod = _obs_report()
+    text = mod.render_text(doc)
+    assert "tenant accounting" in text
+    for name in roll:
+        assert name in text
+    assert mod.main([str(obs_dir)]) == 0

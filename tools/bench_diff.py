@@ -76,6 +76,16 @@ DEFAULT_SPEC = [
      "bound": 1.0},
     {"key": "observability.alerts_fired_on_healthy_run",
      "direction": "max", "bound": 0.0},
+    # wide-event accounting plane (ISSUE 17, docs/observability.md
+    # "Wide events & tenant accounting"): the per-terminal emit +
+    # amortized /tenants rollup stays under 1% of a decode step, and
+    # the per-tenant rollup must re-derive the engine's own
+    # request/token totals EXACTLY — a cost join that doesn't balance
+    # is worse than no join
+    {"key": "observability.wide_event_overhead_pct", "direction": "max",
+     "bound": 1.0},
+    {"key": "observability.tenant_rollup_mismatch", "direction": "max",
+     "bound": 0.0},
     # cost-attribution plane (docs/observability.md "Cost attribution"):
     # the run-time side must stay under 1% of a round, the ledger's
     # per-executable compile budgets are ABSOLUTE walls (CPU-tier tiny

@@ -47,6 +47,31 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def parse_tenant_weights(spec: str | None) -> list[tuple[str, float]] | None:
+    """``"a=3,b=1"`` -> ``[("a", 3.0), ("b", 1.0)]`` — the weighted
+    tenant mix ``--tenants`` drives (bare names weight 1). Labels are
+    sanitized with the same boundary rule the server applies, so the
+    client's per-tenant twins and the server's ``consensusml_tenant_*``
+    children land on identical label values."""
+    from consensusml_tpu.obs import sanitize_tenant
+
+    if not spec:
+        return None
+    out: list[tuple[str, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition("=")
+        weight = float(w) if w else 1.0
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0: {part!r}")
+        out.append((sanitize_tenant(name), weight))
+    if not out:
+        raise ValueError(f"no tenants in {spec!r}")
+    return out
+
+
 def sample_prompt_len(rng, lo: int, hi: int, dist: str = "uniform") -> int:
     """One prompt length in ``[lo, hi]``.
 
@@ -76,6 +101,7 @@ def run_loadgen(
     swap_fn=None,
     temperature: float = 0.0,
     top_p: float = 1.0,
+    tenants: list[tuple[str, float]] | None = None,
     history=None,
     history_tick_s: float = 0.25,
 ) -> dict:
@@ -98,6 +124,18 @@ def run_loadgen(
     sampled token streams end to end (the engine's ``(seed, position)``
     fold keys make the stream a pure function of the request).
 
+    ``tenants`` (``[(name, weight), ...]``, from ``--tenants
+    "a=3,b=1"``) assigns each arrival a tenant label by weighted draw
+    from the fixture rng — deterministic per seed, so a replay issues
+    the identical (tenant, arrival) schedule, and each request's
+    sampling seed additionally folds the tenant in (crc32), so two
+    tenants' streams stay distinct under the same arrival index. The
+    label rides the wire / ``submit(tenant=)``, the terminal record
+    echoes the SERVER-resolved label, and the client records per-tenant
+    labeled SLO twins of its TTFT/latency families — the client half of
+    the per-tenant accounting join (docs/observability.md "Wide events
+    & tenant accounting").
+
     With ``history`` (a :class:`~consensusml_tpu.obs.MetricsHistory`
     over this process's registry), the ``loadgen-history`` sampler
     thread (docs/threads.md) records the client-side rings every
@@ -110,18 +148,22 @@ def run_loadgen(
 
     rng = np.random.default_rng(seed)
     lo, hi = prompt_lens
-    metrics = _LoadgenMetrics(rate_rps)
+    metrics = _LoadgenMetrics(rate_rps, tenant_mode=bool(tenants))
     results: list[dict] = []
     errors: list[str] = []
     lock = threading.Lock()
     threads = []
     swaps = 0
 
-    def one(ids, ctx, sampling):
+    def one(ids, ctx, sampling, tenant):
         try:
             r = submit(ids, max_new_tokens, ctx, sampling)
             r.setdefault("trace_id", ctx.trace_id)
             r.setdefault("request_id", ctx.request_id)
+            # the SERVER-resolved label wins (it sanitized at its
+            # boundary); the issued label is the fallback for plain
+            # result dicts from tenant-unaware submitters
+            r.setdefault("tenant", tenant)
             metrics.observe_result(r)
             with lock:
                 results.append(r)
@@ -143,6 +185,18 @@ def run_loadgen(
         )
         sampler.start()
 
+    tenant_names: list[str] = []
+    tenant_p = None
+    if tenants:
+        import zlib
+
+        tenant_names = [t for t, _w in tenants]
+        total_w = sum(w for _t, w in tenants)
+        tenant_p = [w / total_w for _t, w in tenants]
+        tenant_crc = {
+            t: zlib.crc32(t.encode()) & 0xFFFFFFFF for t in tenant_names
+        }
+
     t_start = time.perf_counter()
     for i in range(n_requests):
         if swap_fn is not None and swap_every and i and i % swap_every == 0:
@@ -154,14 +208,24 @@ def run_loadgen(
         # fixture replays to the same ids, and client + server sides of
         # one request join on trace_id (docs/observability.md)
         ctx = TraceContext(f"lg{seed:x}-{i:05d}")
+        req_seed = ((seed << 20) ^ i) & 0xFFFFFFFF
+        tenant = "default"
+        if tenant_names:
+            # weighted draw from the fixture rng (deterministic per
+            # seed); crc32 folds the tenant into the request seed so
+            # tenants draw distinct streams at the same arrival index
+            tenant = tenant_names[int(rng.choice(len(tenant_names), p=tenant_p))]
+            req_seed ^= tenant_crc[tenant]
         sampling = {
             "temperature": temperature,
             "top_p": top_p,
             # 32-bit per-request seed, disjoint across fixture seeds
-            "seed": ((seed << 20) ^ i) & 0xFFFFFFFF,
+            "seed": req_seed,
         }
+        if tenant_names:
+            sampling["tenant"] = tenant
         t = threading.Thread(
-            target=one, args=(list(map(int, ids)), ctx, sampling)
+            target=one, args=(list(map(int, ids)), ctx, sampling, tenant)
         )
         threads.append(t)
         metrics.observe_issued()
@@ -185,17 +249,39 @@ def run_loadgen(
     # the client-observed worst tail, with identity: each row's
     # trace_id/request_id resolves to a server-side RequestTrace
     slowest = sorted(results, key=lambda r: -r["latency_s"])[:8]
+    tenant_report = None
+    if tenants:
+        tenant_report = {}
+        for t, _w in tenants:
+            rs = [r for r in results if r.get("tenant") == t]
+            tpct = lambda key, q: (
+                float(np.percentile([r[key] for r in rs], q))
+                if rs
+                else float("nan")
+            )
+            tenant_report[t] = {
+                "completed": len(rs),
+                "tokens_out": int(sum(len(r["tokens"]) for r in rs)),
+                "ttft_p50_ms": 1e3 * tpct("ttft_s", 50),
+                "ttft_p99_ms": 1e3 * tpct("ttft_s", 99),
+                "latency_p50_ms": 1e3 * tpct("latency_s", 50),
+                "latency_p99_ms": 1e3 * tpct("latency_s", 99),
+            }
     return {
         "slowest": [
             {
                 "trace_id": r.get("trace_id", ""),
                 "request_id": r.get("request_id", ""),
+                "tenant": r.get("tenant", "default"),
                 "ttft_ms": round(1e3 * r["ttft_s"], 3),
                 "latency_ms": round(1e3 * r["latency_s"], 3),
                 "tokens": len(r["tokens"]),
             }
             for r in slowest
         ],
+        # per-tenant client-observed SLOs (None without --tenants); the
+        # server-side rollup twin is WideEventLog.rollup()
+        "tenants": tenant_report,
         "requests": n_requests,
         "completed": len(results),
         "errors": len(errors),
@@ -227,11 +313,20 @@ class _LoadgenMetrics:
     its own lock) so the history sampler sees the TTFT/latency
     distributions move during the run, not one post-hoc dump."""
 
-    def __init__(self, rate_rps: float):
+    def __init__(self, rate_rps: float, tenant_mode: bool = False):
         from consensusml_tpu.obs import get_registry
         from consensusml_tpu.obs.metrics import DEFAULT_SLO_BUCKETS
 
         reg = get_registry()
+        self._reg = reg
+        self._slo_buckets = DEFAULT_SLO_BUCKETS
+        # per-tenant CLIENT twins of the SLO families (labeled children,
+        # created lazily per observed tenant under --tenants): the
+        # client-observed half of the per-tenant accounting story, in
+        # the same tenant= label space as the server's
+        # consensusml_tenant_* families
+        self.tenant_mode = tenant_mode
+        self._twins: dict[str, dict] = {}
         self.ttft = reg.histogram(
             "consensusml_loadgen_ttft_seconds",
             "client-observed time to first token",
@@ -269,11 +364,35 @@ class _LoadgenMetrics:
         # is the queue-buildup signal the history rings exist to show
         self.requests.inc()
 
+    def _tenant_twins(self, tenant: str) -> dict:
+        tw = self._twins.get(tenant)
+        if tw is None:
+            labels = {"tenant": tenant}
+            tw = self._twins[tenant] = {
+                "ttft": self._reg.histogram(
+                    "consensusml_loadgen_tenant_ttft_seconds",
+                    "client-observed time to first token per tenant",
+                    buckets=self._slo_buckets,
+                    labels=labels,
+                ),
+                "lat": self._reg.histogram(
+                    "consensusml_loadgen_tenant_latency_seconds",
+                    "client-observed end-to-end latency per tenant",
+                    buckets=self._slo_buckets,
+                    labels=labels,
+                ),
+            }
+        return tw
+
     def observe_result(self, r: dict) -> None:
         # exemplar-bearing: the worst buckets remember WHICH request
         rid = r.get("request_id") or None
         self.ttft.observe(r["ttft_s"], exemplar=rid)
         self.lat.observe(r["latency_s"], exemplar=rid)
+        if self.tenant_mode:
+            tw = self._tenant_twins(r.get("tenant") or "default")
+            tw["ttft"].observe(r["ttft_s"], exemplar=rid)
+            tw["lat"].observe(r["latency_s"], exemplar=rid)
         self.completed.inc()
         self.tokens.inc(len(r["tokens"]))
 
@@ -291,7 +410,7 @@ def _engine_submit(engine):
         h = engine.submit(
             ids, max_new, trace=ctx,
             temperature=s.get("temperature"), top_p=s.get("top_p"),
-            seed=s.get("seed"),
+            seed=s.get("seed"), tenant=s.get("tenant"),
         )
         r = h.result(timeout=300)
         return {
@@ -300,6 +419,7 @@ def _engine_submit(engine):
             "temperature": r.temperature, "top_p": r.top_p, "seed": r.seed,
             "spec_proposed": r.spec_proposed,
             "spec_accepted": r.spec_accepted,
+            "tenant": r.tenant,
         }
 
     return submit
@@ -339,6 +459,8 @@ def _socket_submit(host: str, port: int):
                         "seed": msg.get("seed", 0),
                         "spec_proposed": msg.get("spec_proposed", 0),
                         "spec_accepted": msg.get("spec_accepted", 0),
+                        # server-RESOLVED tenant label (sanitized there)
+                        "tenant": msg.get("tenant", "default"),
                     }
                 if ttft is None:  # first streamed token, client-observed
                     ttft = time.perf_counter() - t0
@@ -380,6 +502,14 @@ def main(argv=None) -> int:
                    help="artifact mode: serve speculatively with the "
                         "draft/ subartifact proposing K tokens per round "
                         "(serve.export.export_draft installs one)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="weighted tenant mix, e.g. 'a=3,b=1' (bare names "
+                        "weight 1): each arrival draws a tenant label "
+                        "deterministically from the fixture seed, sends "
+                        "it on the wire / submit(tenant=), and records "
+                        "per-tenant client SLO twins — the client half "
+                        "of the server's wide-event tenant accounting "
+                        "(docs/observability.md)")
     p.add_argument("--seed", type=int, default=0,
                    help="fixture seed: arrival pattern, prompt ids, trace "
                         "ids, AND per-request sampling seeds all derive "
@@ -454,6 +584,7 @@ def main(argv=None) -> int:
         swap_fn=swap_fn,
         temperature=args.temperature,
         top_p=args.top_p,
+        tenants=parse_tenant_weights(args.tenants),
         history=history,
     )
     if engine is not None:

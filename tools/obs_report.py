@@ -197,6 +197,37 @@ def _render_history(doc: dict, add, top: int = 16) -> None:
         )
 
 
+def _render_tenants(doc: dict, add) -> None:
+    """The tenant cost table: fleet-wide per-tenant spend merged from
+    the snapshots' wide-event rollups (tokens, ledger-joined TFLOPs and
+    HBM gigabytes, pool block-seconds, worst TTFT); marked absent for
+    pre-wide-event snapshots — an old directory keeps rendering."""
+    tn = doc.get("tenants")
+    if not tn:
+        add("tenants: absent (no snapshot carries wide-event accounting)")
+        return
+    rows = tn.get("tenants") or {}
+    add(
+        f"tenant accounting ({len(rows)} tenant(s), "
+        f"{tn.get('events_total', 0)} events from "
+        f"{tn.get('ranks_reporting', 0)} snapshot(s)):"
+    )
+    add("  tenant            req   tok_in  tok_out   tflops     hbm        blk-s     worst ttft")
+    for name in sorted(rows):
+        r = rows[name]
+        worst = (r.get("worst_ttft") or [{}])[0]
+        wt = worst.get("ttft_s")
+        add(
+            f"  {name:<15} {r.get('requests', 0):>5}  "
+            f"{r.get('tokens_in', 0):>7}  {r.get('tokens_out', 0):>7}  "
+            f"{'-' if r.get('tflops') is None else format(r['tflops'], '.4g'):>7}  "
+            f"{_fmt_b((r.get('hbm_gbytes') or 0.0) * 1e9):>9}  "
+            f"{'-' if r.get('block_seconds') is None else format(r['block_seconds'], '.3f'):>8}  "
+            f"{_fmt_s(wt)}"
+            + (f" ({worst.get('request_id')})" if wt is not None else "")
+        )
+
+
 def render_text(doc: dict) -> str:
     lines: list[str] = []
     add = lines.append
@@ -366,6 +397,8 @@ def render_text(doc: dict) -> str:
                 f"{_fmt_s(r.get('measured_s')):>9}  "
                 f"{'-' if xf is None else format(xf, '.1f'):>7}"
             )
+    add("")
+    _render_tenants(doc, add)
     hbm = doc.get("hbm")
     if hbm:
         drift = hbm.get("drift_pct") or {}
