@@ -747,6 +747,7 @@ def _serving_bench() -> dict:
     out["paged_ttft_p99_speedup"] = round(slot_t / paged_t, 2) if paged_t else 0.0
     out["fused_attention"] = _fused_attention_compare(bundle.model, params)
     out["spec"] = _spec_serving_bench()
+    out["prefix_cache"] = _prefix_cache_bench()
     return out
 
 
@@ -974,6 +975,144 @@ def _spec_serving_bench() -> dict:
         if out["baseline"]["wall_tokens_per_sec"]
         else 0.0
     )
+    return out
+
+
+def _prefix_cache_bench() -> dict:
+    """Prefix-cache block of the serving section (ISSUE 18): the paged
+    engine under a shared-system-prompt mix — ONE fixed prefix on ~90%
+    of arrivals, per-arrival random suffixes — served with the
+    content-addressed prefix index ON vs OFF at identical load and seed
+    (same arrival schedule, same prompts, same token streams).
+
+    Acceptance numbers: admission hit rate and prefill tokens actually
+    computed (the suffix-only claim, measured on the engine's own
+    counter), TTFT p50/p99 with the speedup ratio (a hit prefills a
+    14-token suffix instead of a 30-token prompt), pool blocks/bytes
+    saved by sharing, and the zero-recompile check extended to the
+    ``prefix_prefill`` executable family. The ``zero_hit`` sub-block
+    serves a FULLY RANDOM mix against the SAME index-armed engine —
+    hits must be 0, and the index's only cost is the per-admission
+    hash-and-miss, micro-measured and reported as a fraction of a
+    p50 request (the <1%-overhead-at-0%-hit claim bench_diff gates)."""
+    import time as _time
+
+    import jax
+
+    from consensusml_tpu import configs
+    from consensusml_tpu.serve import Engine, ServeConfig
+    from consensusml_tpu.serve.pool import PrefixIndex
+    from consensusml_tpu.utils.tree import consensus_mean
+    from tools.loadgen import _engine_submit, run_loadgen
+
+    n_requests = int(os.environ.get("BENCH_PREFIX_REQUESTS", "48"))
+    rate = float(os.environ.get("BENCH_PREFIX_RATE", "500"))
+    max_len, max_new, block, lanes = 32, 4, 8, 8
+    prefix_len, share_frac = 16, 0.9
+    suffix_lens = (1, max_len - max_new - prefix_len)
+    bundle = configs.build("gpt2_topk", "smoke")
+    stacked = jax.vmap(bundle.init_params)(
+        jax.random.split(jax.random.key(0), bundle.world_size)
+    )
+    params = consensus_mean(stacked)
+
+    def drive(prefix_cache: bool, shared: bool):
+        cfg = ServeConfig(
+            num_slots=lanes, max_len=max_len, max_new_tokens=max_new,
+            kv_impl="paged", block_size=block, prefix_cache=prefix_cache,
+        )
+        engine = Engine(bundle.model, params, cfg)
+        warm = engine.warmup()
+        report = run_loadgen(
+            _engine_submit(engine),
+            n_requests=n_requests,
+            rate_rps=rate,
+            prompt_lens=suffix_lens,
+            vocab=bundle.model.config.vocab_size,
+            max_new_tokens=max_new,
+            len_dist="zipf",
+            shared_prefix=(prefix_len, share_frac) if shared else None,
+        )
+        stats = engine.stats()
+        engine.shutdown()
+        return warm, report, stats
+
+    out = {
+        "config": (
+            f"gpt2_topk smoke, {lanes} paged lanes, max_len {max_len}, "
+            f"{prefix_len}-token shared prefix on {share_frac:.0%} of "
+            f"arrivals, zipf suffixes {suffix_lens[0]}:{suffix_lens[1]}, "
+            f"{max_new} new tokens — prefix cache on vs off, same seed"
+        ),
+        "requests": n_requests,
+    }
+    for key, prefix_cache in (("unshared", False), ("shared", True)):
+        warm, report, stats = drive(prefix_cache, shared=True)
+        entry = {
+            "tokens_per_sec": round(report["tokens_per_sec"], 1),
+            "ttft_p50_ms": round(report["ttft_p50_ms"], 2),
+            "ttft_p99_ms": round(report["ttft_p99_ms"], 2),
+            "prefill_tokens_computed": stats["prefill_tokens_computed"],
+            "errors": report["errors"],
+            "zero_recompiles_after_warmup": (
+                stats["compile_counts"] == warm
+            ),
+        }
+        if prefix_cache:
+            pc = stats["prefix_cache"]
+            entry.update(
+                hit_rate=round(pc["hit_rate"], 4),
+                hits=pc["hits"],
+                hit_blocks=pc["hit_blocks"],
+                cow_copies=pc["cow_copies"],
+                bytes_saved=pc["bytes_saved"],
+                shared_blocks_peak=pc["shared_blocks"],
+            )
+        out[key] = entry
+    # the headline ratios: a hit admission prefills the unshared suffix
+    # bucket instead of the full prompt bucket
+    un, sh = out["unshared"], out["shared"]
+    out["ttft_p50_speedup"] = (
+        round(un["ttft_p50_ms"] / sh["ttft_p50_ms"], 2)
+        if sh["ttft_p50_ms"]
+        else 0.0
+    )
+    out["ttft_p99_speedup"] = (
+        round(un["ttft_p99_ms"] / sh["ttft_p99_ms"], 2)
+        if sh["ttft_p99_ms"]
+        else 0.0
+    )
+    out["prefill_tokens_saved_frac"] = (
+        round(1.0 - sh["prefill_tokens_computed"] / un["prefill_tokens_computed"], 4)
+        if un["prefill_tokens_computed"]
+        else 0.0
+    )
+
+    # 0%-hit overhead: fully random load against the armed index. The
+    # wall-clock delta of two serve runs is dispatch noise, so the
+    # index cost is micro-measured instead: per-admission lookup (hash
+    # every full chunk of a max_len prompt, miss) as a fraction of the
+    # measured p50 request — the honest "what does arming cost a
+    # workload that never hits" number.
+    warm, report, stats = drive(True, shared=False)
+    pc = stats["prefix_cache"]
+    idx = PrefixIndex(block)
+    miss_ids = list(range(max_len))
+    reps = 2000
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        idx.lookup("default", 0, miss_ids)
+    lookup_s = (_time.perf_counter() - t0) / reps
+    lat_p50_s = report["latency_p50_ms"] / 1e3
+    out["zero_hit"] = {
+        "hits": pc["hits"],
+        "ttft_p50_ms": round(report["ttft_p50_ms"], 2),
+        "lookup_us": round(1e6 * lookup_s, 2),
+        "overhead_pct": (
+            round(100.0 * lookup_s / lat_p50_s, 4) if lat_p50_s > 0 else 0.0
+        ),
+        "zero_recompiles_after_warmup": stats["compile_counts"] == warm,
+    }
     return out
 
 

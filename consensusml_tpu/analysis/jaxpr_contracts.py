@@ -574,20 +574,28 @@ def _cache_drift(
 def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
     """Paged serving-stage contracts (causal-LM configs only).
 
-    The pool engine (``serve/pool/``) runs TWO separately-jitted stages;
-    each carries the full contract set INDEPENDENTLY — a clean decode
-    jaxpr does not excuse a host callback in the prefill scatter:
+    The pool engine (``serve/pool/``) runs THREE separately-jitted
+    stages — full prefill, prefix-suffix prefill (the prefix cache's
+    unshared-suffix admission, including its in-trace copy-on-write),
+    and decode; each carries the full contract set INDEPENDENTLY — a
+    clean decode jaxpr does not excuse a host callback in the prefill
+    scatter:
 
     - no host callbacks anywhere, in particular not in the block-index
       computation (``physical = table[s, p // bs]`` must stay on device
-      — a host round-trip there fences the pipeline once per token);
+      — a host round-trip there fences the pipeline once per token) and
+      not in the prefix path's COW copy (divergence is resolved
+      HOST-side at planning time; the jit only ever sees two block ids);
     - no f64/complex128 (block indices are int32; KV pages are the
       model's compute dtype);
     - step-over-step canonical-jaxpr hash stable PER STAGE: prefill's
       output pages feed the next prefill, decode's output pages feed the
       next decode — both must retrace byte-identically, and the page
       pytree must be structure/shape/dtype-stable (donation depends on
-      it).
+      it). The prefix stage keys on the SUFFIX bucket alone — one
+      executable per bucket regardless of how an admission splits into
+      matched prefix + computed suffix, which is what keeps the
+      zero-recompile contract intact under any hit pattern.
     """
     import jax
     import jax.numpy as jnp
@@ -628,6 +636,23 @@ def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
         "paged prefill", "signature-hash",
     )
 
+    # -- prefix-suffix prefill stage (traced at the same bucket) -----------
+    mkx = lambda rule, detail, msg: Finding(
+        PASS, rule, f"configs:{name}", "paged_prefix_prefill", detail, msg
+    )
+    prefix_prefill = P.make_prefix_prefill_fn(dm)
+    pargs = P.prefix_prefill_cost_args(max_len, bs, blocks_per_slot)
+    closed = jax.make_jaxpr(prefix_prefill)(params, pages, *pargs)
+    findings += _callback_f64_findings(closed, mkx, "paged prefix-prefill stage")
+    _tok, _logits, prefix_pages = jax.eval_shape(
+        prefix_prefill, params, pages, *pargs
+    )
+    findings += _hash_stable(
+        mkx, prefix_prefill, closed,
+        (params, prefix_pages, *pargs),
+        "paged prefix prefill", "signature-hash",
+    )
+
     # -- decode stage ------------------------------------------------------
     mkd = lambda rule, detail, msg: Finding(
         PASS, rule, f"configs:{name}", "paged_decode", detail, msg
@@ -651,6 +676,7 @@ def _check_paged_stage_jaxprs(name: str, bundle) -> list[Finding]:
     )
     for stage, mk, out in (
         ("prefill", mkp, prefill_pages),
+        ("prefix prefill", mkx, prefix_pages),
         ("decode", mkd, out_pages),
     ):
         findings += _cache_drift(

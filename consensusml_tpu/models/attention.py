@@ -22,6 +22,7 @@ __all__ = [
     "update_kv_cache",
     "paged_update_kv_cache",
     "paged_update_kv_cache_window",
+    "paged_cow_copy",
     "gather_paged_kv",
     "apply_rope",
     "rope_frequencies",
@@ -43,6 +44,7 @@ def dot_product_attention(
     causal: bool = False,
     bias: jax.Array | None = None,
     kv_mask: jax.Array | None = None,
+    mask: jax.Array | None = None,
     dtype: Any = jnp.bfloat16,
     impl: str = "auto",
 ) -> jax.Array:
@@ -61,9 +63,22 @@ def dot_product_attention(
 
     ``kv_mask`` ((B, T), nonzero = attend) is the per-key padding mask —
     BERT's attention_mask. Unlike a general additive ``bias`` it rides
-    the flash kernel (one f32 row per batch); on the other impls it is
-    folded into the bias. Pass at most one of ``bias``/``kv_mask`` for a
-    padding mask; arbitrary score biases still need ``bias``.
+    the flash kernel (one f32 row per batch); on blockwise it is folded
+    into the bias, and on dense it is applied with ``where`` like
+    ``mask``. Pass at most one of ``bias``/``kv_mask`` for a padding
+    mask; arbitrary score biases still need ``bias``.
+
+    ``mask`` ((B, S, T) or (B, 1, T) boolean, True = attend) is the
+    per-query-row exclusion mask, dense-only, applied with ``jnp.where``
+    on the f32 logits — NOT as an additive bias. The distinction
+    matters when excluded KEYS hold non-finite garbage (e.g. ±inf in a
+    stale pool page): ``garbage + (-1e30)`` keeps the garbage while
+    ``where`` replaces the score outright. Excluded columns contribute
+    exactly zero probability either way. Note the VALUE side has no
+    such shield — probability-zero rows still enter the output matmul
+    as ``0 * v``, so NaN values poison the sum regardless of masking;
+    pool writers must keep even junk rows finite (see the clamped
+    position-table lookups in :func:`apply_rope` / gpt2's ``wpe``).
     """
     if kv_mask is not None:
         if bias is not None:
@@ -87,6 +102,11 @@ def dot_product_attention(
             impl = "flash"
         else:
             impl = "blockwise"
+    if mask is not None and impl != "dense":
+        raise ValueError(
+            f"mask= is dense-only (where-masking on the materialized "
+            f"score matrix), got impl={impl!r}"
+        )
     if impl == "flash":
         if bias is not None:
             raise ValueError(
@@ -99,8 +119,11 @@ def dot_product_attention(
         return flash_attention(
             q, k, v, causal=causal, kv_mask=kv_mask, dtype=dtype
         )
-    if kv_mask is not None:  # non-flash impls take it as an additive bias
-        bias = jnp.where(kv_mask[:, None, None, :] > 0, 0.0, _NEG_INF)
+    if kv_mask is not None:
+        if impl == "dense":  # where-masked below, garbage-robust
+            mask = kv_mask[:, None, :] > 0
+        else:  # blockwise takes it as an additive bias
+            bias = jnp.where(kv_mask[:, None, None, :] > 0, 0.0, _NEG_INF)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal, bias=bias, dtype=dtype)
     if impl != "dense":
@@ -114,6 +137,12 @@ def dot_product_attention(
     ) * scale
     if bias is not None:
         logits = logits + jnp.asarray(bias, jnp.float32)
+    if mask is not None:
+        # broadcast (B, S|1, T) over heads; where, not +bias: a NaN score
+        # from garbage keys must not survive its own exclusion
+        logits = jnp.where(
+            mask[:, None], logits, jnp.asarray(_NEG_INF, jnp.float32)
+        )
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), jnp.bool_), k=t - s)
@@ -322,6 +351,27 @@ def paged_update_kv_cache_window(
     return k_pages, v_pages
 
 
+def paged_cow_copy(
+    cache: dict[str, jax.Array],
+    src: jax.Array,  # () physical block id — shared block being diverged
+    dst: jax.Array,  # () physical block id — the diverging slot's fresh block
+) -> dict[str, jax.Array]:
+    """Copy one physical block's K/V rows ``src -> dst`` inside the jit
+    — the prefix cache's copy-on-write step. A slot whose first write
+    would land mid-way into a block other streams still share instead
+    (a) points its block-table entry at a fresh block and (b) runs this
+    copy before the scatter, so the fresh block holds the shared rows
+    plus the slot's own writes while every other holder keeps reading
+    the untouched source. ``src == dst == 0`` (the trash block) is the
+    disabled case: a trash self-copy is a benign no-op lane, the same
+    trick the decode scatter plays for free lanes — one executable
+    whether or not this admission diverged, no host sync either way."""
+    return {
+        "k": cache["k"].at[dst].set(cache["k"][src]),
+        "v": cache["v"].at[dst].set(cache["v"][src]),
+    }
+
+
 def gather_paged_kv(
     k_pages: jax.Array,  # (N, bs, H, D)
     v_pages: jax.Array,  # (N, bs, H, D)
@@ -395,12 +445,18 @@ def cached_attention_window(
     exclusion past each slot's length, so no separate causal matrix is
     needed. ``W = 1`` with ``positions[:, None]`` degenerates to exactly
     :func:`cached_attention`'s mask.
+
+    The mask rides ``mask=`` (a ``where`` on the logits), not an
+    additive bias: excluded trash-block rows hold junk that only stays
+    finite by the position-clamp convention (overflow window lanes
+    embed a clamped position, then scatter to trash), and ``where``
+    keeps the score side robust even if that junk is extreme — an
+    additive ``junk + (-1e30)`` would carry ±inf straight through.
     """
     t = k_cache.shape[1]
     mask = jnp.arange(t)[None, None, :] <= positions[:, :, None]  # (B, W, T)
-    bias = jnp.where(mask[:, None], 0.0, _NEG_INF)  # (B, 1, W, T)
     return dot_product_attention(
-        q, k_cache, v_cache, bias=bias, dtype=dtype, impl="dense"
+        q, k_cache, v_cache, mask=mask, dtype=dtype, impl="dense"
     )
 
 
@@ -419,7 +475,14 @@ def apply_rope(x: jax.Array, table: jax.Array, positions: jax.Array | None = Non
     if positions is None:
         cs = table[:s]  # (S, D/2, 2)
     else:
-        cs = table[positions]  # (B?, S, D/2, 2) — positions (S,) or (B, S)
+        # clamped lookup: window lanes past a slot's block table carry
+        # positions >= max_len by design (they scatter to trash and are
+        # masked everywhere) — unclamped, jnp's out-of-bounds NaN fill
+        # would ride the K rows into the pool and poison even excluded
+        # attention rows via 0 * NaN in the output matmul
+        cs = table[
+            jnp.minimum(positions, table.shape[0] - 1)
+        ]  # (B?, S, D/2, 2) — positions (S,) or (B, S)
     cos = cs[..., 0]
     sin = cs[..., 1]
     # reshape to pairs

@@ -226,7 +226,15 @@ class GPT2LM(nn.Module):
             pos = jnp.arange(s)[None, :]
         else:
             pos = positions if multi else positions[:, None]
-        x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="wpe")(pos)
+        # clamp the TABLE LOOKUP only (raw positions still drive the
+        # paged scatter + masks): window lanes past a slot's block table
+        # legitimately carry positions >= max_len — they scatter to the
+        # trash block and every consumer masks them, but an unclamped
+        # lookup is jnp's NaN fill, and NaN K/V poisons even EXCLUDED
+        # attention rows through 0 * NaN in the output matmul
+        x = x + nn.Embed(c.max_len, c.hidden, dtype=c.dtype, name="wpe")(
+            jnp.minimum(pos, c.max_len - 1)
+        )
         x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
         # static_argnums: `deterministic` is a python bool, not a tracer.
         # The serving paths (kv_cache / return_kv) bypass remat outright:

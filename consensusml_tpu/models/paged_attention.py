@@ -15,7 +15,7 @@ Bit-exactness is the contract, not a goal: every impl reproduces the
 two-step gather path to the last bit (the PR 9 fused-wire playbook).
 The kernel body mirrors the dense reference op-for-op — same bf16-in /
 f32-accumulate dots with the same batch/contracting dims, same
-``1/sqrt(d)`` f32 scale, same 0/-1e30 additive bias, same f32 softmax,
+``1/sqrt(d)`` f32 scale, same where-to-(-1e30) mask, same f32 softmax,
 same probs-in-compute-dtype output matmul — so interpret mode, the
 compiled TPU kernel, and the jnp reference are pinned against the
 gather path across both model families (tests/test_fused_paged_attention.py).
@@ -92,8 +92,8 @@ def _make_kernel(w: int, nb: int, rep: int, name: str):
     run the dense-reference attention math on them.
 
     The body is deliberately NOT an online softmax: it replays the dense
-    reference's exact op sequence (dot f32-accum -> scale -> additive
-    bias -> f32 softmax -> dtype-cast probs dot) with the same
+    reference's exact op sequence (dot f32-accum -> scale -> where
+    mask -> f32 softmax -> dtype-cast probs dot) with the same
     batch/contracting dimension numbers, which is what makes the fused
     output bit-identical to the gather path instead of merely close.
     """
@@ -126,17 +126,18 @@ def _make_kernel(w: int, nb: int, rep: int, name: str):
             )
             * scale
         )  # (1, H, W, T) f32
-        # per-window-row length mask as an ADDITIVE 0/-1e30 bias — the
-        # reference's exact masking arithmetic, not a where on logits
+        # per-window-row length mask as a WHERE on the logits — the
+        # reference's exact masking arithmetic (attention.py applies
+        # padding masks with where, not an additive bias, so extreme
+        # garbage in excluded trash-block keys cannot ride an additive
+        # mask through; excluded columns contribute exactly zero)
         t_row = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
-        bias = jnp.concatenate(
-            [
-                jnp.where(t_row <= pos_ref[s, i], 0.0, _NEG_INF)
-                for i in range(w)
-            ],
-            axis=0,
-        )  # (W, T)
-        logits = logits + jnp.asarray(bias, jnp.float32)[None, None]
+        keep = jnp.concatenate(
+            [t_row <= pos_ref[s, i] for i in range(w)], axis=0
+        )  # (W, T) bool
+        logits = jnp.where(
+            keep[None, None], logits, jnp.asarray(_NEG_INF, jnp.float32)
+        )
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum(
             "bhst,bthd->bshd", probs.astype(o_ref.dtype), v[None],

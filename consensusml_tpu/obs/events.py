@@ -198,6 +198,8 @@ class WideEventLog:
                     "tflops": 0.0,
                     "hbm_gbytes": 0.0,
                     "block_seconds": 0.0,
+                    "shared_block_seconds": 0.0,
+                    "prefix_hit_blocks": 0,
                     "decode_ticks": 0,
                     "defer_ticks": 0,
                     "preemptions": 0,
@@ -209,6 +211,12 @@ class WideEventLog:
             agg["tflops"] += float(ev.get("tflops") or 0.0)
             agg["hbm_gbytes"] += float(ev.get("hbm_bytes") or 0.0) / 1e9
             agg["block_seconds"] += float(ev.get("block_seconds") or 0.0)
+            # prefix-cache attribution: shared holds roll up separately
+            # from the charged (exclusive) block-seconds above
+            agg["shared_block_seconds"] += float(
+                ev.get("shared_block_seconds") or 0.0
+            )
+            agg["prefix_hit_blocks"] += int(ev.get("prefix_hit_blocks") or 0)
             agg["decode_ticks"] += int(ev.get("decode_ticks") or 0)
             agg["defer_ticks"] += int(ev.get("defer_ticks") or 0)
             agg["preemptions"] += int(ev.get("preemptions") or 0)
@@ -228,6 +236,9 @@ class WideEventLog:
             agg["tflops"] = round(agg["tflops"], 6)
             agg["hbm_gbytes"] = round(agg["hbm_gbytes"], 6)
             agg["block_seconds"] = round(agg["block_seconds"], 6)
+            agg["shared_block_seconds"] = round(
+                agg["shared_block_seconds"], 6
+            )
         return out
 
     def snapshot(self, last_n: int = 64) -> dict[str, Any]:

@@ -51,9 +51,17 @@ class GenResult:
     # per-tenant attribution (obs/events.py): the workload label the
     # request carried through submit(); "default" for unlabeled clients
     tenant: str = "default"
-    # pool block-seconds this stream held, integrated over hold time
-    # (survives preemption + re-admission; 0.0 on the slot-cache path)
+    # pool block-seconds this stream held EXCLUSIVELY, integrated over
+    # hold time (survives preemption + re-admission; 0.0 on the
+    # slot-cache path). Prefix-shared holds land in
+    # shared_block_seconds instead — a request is charged only for
+    # blocks it kept alive on its own.
     block_seconds: float = 0.0
+    shared_block_seconds: float = 0.0
+    # prefix-cache accounting: pool blocks this request's admissions
+    # mapped in from the index instead of prefilling (0 = every token
+    # was computed)
+    prefix_hit_blocks: int = 0
 
 
 class RequestHandle:
@@ -128,6 +136,15 @@ class Request:
     decode_ticks: int = 0
     block_seconds: float = 0.0
     prefill_buckets: list[int] = dataclasses.field(default_factory=list)
+    # prefix-cache accounting (engine-thread writes): blocks adopted
+    # from the index across this request's admissions, block-seconds of
+    # SHARED holds (split out of block_seconds — the request is charged
+    # only unshared time), and the admission kind per prefill_buckets
+    # entry ("full" | "prefix" — _request_cost joins each bucket
+    # against the ledger row of the executable that actually ran)
+    prefix_hit_blocks: int = 0
+    shared_block_seconds: float = 0.0
+    prefill_kinds: list[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

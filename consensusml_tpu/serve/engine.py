@@ -85,6 +85,14 @@ class ServeConfig:
     block_size: int = 8  # tokens per physical KV block (must divide max_len)
     num_blocks: int = 0  # pool size; 0 = num_slots * max_len/block_size + 1
     prefill_budget: int = 0  # prefill tokens per tick; 0 = one max_len bucket
+    # refcounted prefix-block sharing (serve/pool/prefix.py): matched
+    # block-aligned prompt prefixes map into the slot's table and only
+    # the unshared suffix prefills. Bit-exact vs the unshared path, so
+    # the switch is a perf/memory knob, never a quality one. Opt-in:
+    # warmup compiles one extra prefill executable per suffix bucket
+    # (plus draft twins under spec decode), so engines that never see
+    # repeated prompts shouldn't pay that compile time.
+    prefix_cache: bool = False
     # paged-attention tier (models/paged_attention.py): "gather" = the
     # two-step reference (the measured default until TPU floor-ratio
     # data flips it); "auto" resolves via resolve_attention_impl —
@@ -179,6 +187,18 @@ class Engine:
             self._sched = P.AdmissionScheduler(
                 cfg.prefill_budget or self.max_len
             )
+            # content-addressed prefix sharing (serve/pool/prefix.py):
+            # the index invalidates eagerly on block reuse (reuse_hook)
+            # and freed-but-indexed blocks park at the bottom of the
+            # free stack (cached_hook) so cached prefixes die last
+            self._prefix = None
+            if cfg.prefix_cache:
+                self._prefix = P.PrefixIndex(cfg.block_size)
+                self._pool.reuse_hook = self._prefix.invalidate_block
+                self._pool.cached_hook = self._prefix.cached
+                self._prefix_prefill_fn = P.make_prefix_prefill_fn(
+                    dm, attn_impl=self.attn_impl
+                )
         else:
             self.buckets = D.prefill_buckets(self.max_len)
             self._pool = None
@@ -186,6 +206,7 @@ class Engine:
             self._prefill_fn = D.make_prefill_fn(dm)
             self._decode_fn = D.make_decode_fn(dm)
             self._sched = None
+            self._prefix = None
         self._score_fn = D.make_score_fn(dm)
         # -- speculative decode (serve/pool/spec.py): a draft model over
         # its own smaller pages, one fused k-verify on the target -------
@@ -232,6 +253,13 @@ class Engine:
                 )
                 - self._pool.blocks_per_slot
             )
+            if self._prefix is not None:
+                # draft pages share the pool's block table, so a prefix
+                # hit skips the DRAFT prefill too — same program family
+                # over the draft's own pages
+                self._draft_prefix_prefill_fn = P.make_prefix_prefill_fn(
+                    sd, attn_impl=self.attn_impl
+                )
         self._Request, self._RequestHandle = Request, RequestHandle
 
         self._queue: "queue.Queue" = queue.Queue(cfg.queue_depth)
@@ -373,6 +401,10 @@ class Engine:
                 int(x.nbytes) for x in jax.tree.leaves(self._pages)
             )
             self._block_nbytes = pool_bytes // max(self._pool.num_blocks, 1)
+            if self.spec is not None:
+                self._draft_block_nbytes = sum(
+                    int(x.nbytes) for x in jax.tree.leaves(self._draft_pages)
+                ) // max(self._pool.num_blocks, 1)
             self._m_pool_hbm = reg.gauge(
                 "consensusml_pool_hbm_bytes",
                 "device bytes held by the paged KV block pool (all layers)",
@@ -385,6 +417,38 @@ class Engine:
             )
             self._m_pool_hbm_free.set(
                 self._pool.free_blocks * self._block_nbytes
+            )
+        if self._prefix is not None:
+            self._m_prefix_hits = reg.counter(
+                "consensusml_prefix_hits_total",
+                "admissions that adopted at least one indexed prefix block",
+            )
+            self._m_prefix_misses = reg.counter(
+                "consensusml_prefix_misses_total",
+                "admissions that prefilled from scratch (no indexed prefix)",
+            )
+            self._m_prefix_hit_blocks = reg.counter(
+                "consensusml_prefix_hit_blocks_total",
+                "KV blocks mapped in from the prefix index instead of "
+                "prefilled",
+            )
+            self._m_prefix_cow_copies = reg.counter(
+                "consensusml_prefix_cow_copies_total",
+                "copy-on-write block copies (full-match divergence: the "
+                "last shared block copied to a fresh page in-jit)",
+            )
+            self._m_prefix_bytes_saved = reg.counter(
+                "consensusml_prefix_bytes_saved_total",
+                "KV bytes NOT materialized thanks to prefix sharing "
+                "(adopted blocks x per-block bytes, draft pages included)",
+            )
+            self._m_prefix_entries = reg.gauge(
+                "consensusml_prefix_entries",
+                "live prefix-index entries (current generation)",
+            )
+            self._m_prefix_shared_blocks = reg.gauge(
+                "consensusml_prefix_shared_blocks",
+                "physical blocks currently held by more than one stream",
             )
 
         # host-side SLO accumulators for bench/loadgen percentiles —
@@ -407,6 +471,16 @@ class Engine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_tokens = 0  # emitted by verify rounds (prefill excluded)
+        # prefix-cache host accumulators (mirror the counters for
+        # stats()/bench reads without registry scrapes); the tokens-
+        # computed counter runs on EVERY paged engine so a prefix-off
+        # baseline reports the same field
+        self._prefill_tokens_computed = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_hit_blocks = 0
+        self._prefix_cow_copies = 0
+        self._prefix_bytes_saved = 0
         self._error: BaseException | None = None
 
         self._thread = threading.Thread(
@@ -547,9 +621,18 @@ class Engine:
         shapes (jit caches key on shape, so the executables are shared
         with the live path) — the engine thread may already be serving,
         and warmup must not mutate (or donate away) the cache it is
-        using. Transient cost: one extra cache's worth of memory. In
-        paged mode the throwaway pool's all-zero block table routes every
-        warmup write into the trash block.
+        using. In paged mode the throwaway pool's all-zero block table
+        routes every warmup write into the trash block.
+
+        The program FAMILIES compile on parallel chains (XLA releases
+        the GIL): the full-prefill chain, the prefix-suffix chain, and
+        their draft twins each thread a private throwaway cache through
+        their bucket ladder, so arming the prefix cache (or a draft)
+        widens warmup instead of lengthening it — wall time stays ~the
+        longest single chain. Transient cost: one extra cache per
+        ACTIVE chain (a prefix-off, non-speculative engine allocates
+        exactly one, as before); memory-tight deployments can stage via
+        repeated ``warmup(buckets=[b])`` calls.
         """
         import jax.numpy as jnp
 
@@ -567,47 +650,97 @@ class Engine:
             from consensusml_tpu.serve import pool as P
 
             bs = self.config.block_size
-            pages = P.init_pages(self._dm, self._pool.num_blocks, bs)
-            dpages = (
-                P.init_pages(self._draft_dm, self._pool.num_blocks, bs)
-                if self.spec is not None
-                else None
-            )
-            for b in buckets if buckets is not None else self.buckets:
-                ids = jnp.zeros((1, b), jnp.int32)
-                row = jnp.zeros((b // bs,), jnp.int32)
-                _tok, _logits, pages = self._prefill_fn(
-                    self._params, pages, ids, jnp.int32(1), row, *samp1
+            bks = list(buckets if buckets is not None else self.buckets)
+            trash = jnp.int32(P.TRASH_BLOCK)
+
+            def chain_target():
+                pages = P.init_pages(self._dm, self._pool.num_blocks, bs)
+                for b in bks:
+                    ids = jnp.zeros((1, b), jnp.int32)
+                    row = jnp.zeros((b // bs,), jnp.int32)
+                    _tok, _logits, pages = self._prefill_fn(
+                        self._params, pages, ids, jnp.int32(1), row, *samp1
+                    )
+                if self.spec is None:
+                    # a speculative engine never runs the one-token
+                    # decode step (_spec_step replaces it) — don't burn
+                    # a compile on an executable that will not execute
+                    table = jnp.zeros(
+                        (s, self._pool.blocks_per_slot), jnp.int32
+                    )
+                    self._decode_fn(
+                        self._params, pages, table, toks,
+                        jnp.zeros_like(toks), *samp,
+                    )
+                else:
+                    stable = jnp.zeros(
+                        (s, self._pool.blocks_per_slot + self._spec_extra_cols),
+                        jnp.int32,
+                    )
+                    dpg = P.init_pages(
+                        self._draft_dm, self._pool.num_blocks, bs
+                    )
+                    props, q_sel, q_probs, _dpg = self._propose_fn(
+                        self._draft_params, dpg, stable, toks,
+                        jnp.zeros_like(toks), *samp,
+                    )
+                    self._verify_fn(
+                        self._params, pages, stable, toks, props, q_sel,
+                        q_probs, jnp.zeros_like(toks), *samp,
+                    )
+
+            def chain_prefix(dm, params, fn):
+                # the prefix path's suffix buckets walk the SAME ladder
+                # — compile each so a hit never compiles on the serving
+                # thread (all-trash row + trash COW pair = no-op writes)
+                pages = P.init_pages(dm, self._pool.num_blocks, bs)
+                for b in bks:
+                    ids = jnp.zeros((1, b), jnp.int32)
+                    prow = jnp.zeros(
+                        (self._pool.blocks_per_slot + b // bs,), jnp.int32
+                    )
+                    _t, _l, pages = fn(
+                        params, pages, ids, jnp.int32(1), jnp.int32(0),
+                        prow, trash, trash, *samp1,
+                    )
+
+            def chain_draft():
+                dpages = P.init_pages(
+                    self._draft_dm, self._pool.num_blocks, bs
                 )
-                if self.spec is not None:
+                for b in bks:
+                    ids = jnp.zeros((1, b), jnp.int32)
+                    row = jnp.zeros((b // bs,), jnp.int32)
                     _t, _l, dpages = self._draft_prefill_fn(
                         self._draft_params, dpages, ids, jnp.int32(1),
                         row, *samp1,
                     )
-            if self.spec is None:
-                # a speculative engine never runs the one-token decode
-                # step (_spec_step replaces it) — don't burn a compile
-                # on an executable that will not execute
-                table = jnp.zeros(
-                    (s, self._pool.blocks_per_slot), jnp.int32
+
+            chains = [chain_target]
+            if self._prefix is not None:
+                chains.append(
+                    lambda: chain_prefix(
+                        self._dm, self._params, self._prefix_prefill_fn
+                    )
                 )
-                _tok2, pages = self._decode_fn(
-                    self._params, pages, table, toks,
-                    jnp.zeros_like(toks), *samp,
-                )
+            if self.spec is not None:
+                chains.append(chain_draft)
+                if self._prefix is not None:
+                    chains.append(
+                        lambda: chain_prefix(
+                            self._draft_dm, self._draft_params,
+                            self._draft_prefix_prefill_fn,
+                        )
+                    )
+            if len(chains) == 1:
+                chains[0]()
             else:
-                stable = jnp.zeros(
-                    (s, self._pool.blocks_per_slot + self._spec_extra_cols),
-                    jnp.int32,
-                )
-                props, q_sel, q_probs, dpages = self._propose_fn(
-                    self._draft_params, dpages, stable, toks,
-                    jnp.zeros_like(toks), *samp,
-                )
-                self._verify_fn(
-                    self._params, pages, stable, toks, props, q_sel,
-                    q_probs, jnp.zeros_like(toks), *samp,
-                )
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(len(chains)) as ex:
+                    futs = [ex.submit(c) for c in chains]
+                    for f in futs:
+                        f.result()  # re-raise any chain's failure here
             return self.compile_counts()
         cache = D.init_cache(self._dm, self.config.num_slots, self.max_len)
         for b in buckets if buckets is not None else self.buckets:
@@ -695,6 +828,12 @@ class Engine:
         self._swaps += 1
         self._m_swaps.inc()
         self._m_generation.set(sw.generation)
+        if self._prefix is not None:
+            # stale-generation entries are already unreachable (lookups
+            # key on the current generation); this reclaims them and
+            # lets the pool stop favoring their blocks as cached
+            self._prefix.drop_stale(sw.generation)
+            self._m_prefix_entries.set(len(self._prefix))
 
     def compile_counts(self) -> dict[str, int]:
         """Jit-cache entry counts per program family — the
@@ -705,12 +844,18 @@ class Engine:
             ("decode", self._decode_fn),
             ("score", self._score_fn),
         ]
+        if self._prefix is not None:
+            fams.append(("prefix_prefill", self._prefix_prefill_fn))
         if self.spec is not None:
             fams += [
                 ("draft_prefill", self._draft_prefill_fn),
                 ("propose", self._propose_fn),
                 ("verify", self._verify_fn),
             ]
+            if self._prefix is not None:
+                fams.append(
+                    ("draft_prefix_prefill", self._draft_prefix_prefill_fn)
+                )
         for name, fn in fams:
             size = getattr(fn, "_cache_size", None)
             out[name] = int(size()) if size is not None else -1
@@ -775,6 +920,24 @@ class Engine:
                     *prefill_cost_args(b, bs),
                     meta={**base_meta, "bucket": b, "block_size": bs},
                 )
+            if self._prefix is not None:
+                from consensusml_tpu.serve.pool.stages import (
+                    prefix_prefill_cost_args,
+                )
+
+                # one row per SUFFIX bucket: _request_cost joins each
+                # prefix-hit admission against the bucket that actually
+                # ran, so a 32-prompt admitted on an 8-token suffix is
+                # charged the b8 executable, not the b32 one
+                for b in self.buckets:
+                    name = f"serve.prefix_prefill.b{b}"
+                    rows[name] = ledger.register(
+                        name, self._prefix_prefill_fn, params, pages,
+                        *prefix_prefill_cost_args(
+                            b, bs, self._pool.blocks_per_slot
+                        ),
+                        meta={**base_meta, "bucket": b, "block_size": bs},
+                    )
             rows["serve.decode"] = ledger.register(
                 "serve.decode", self._decode_fn, params, pages,
                 *decode_cost_args(
@@ -825,6 +988,23 @@ class Engine:
                         *prefill_cost_args(b, bs),
                         meta={**spec_meta, "bucket": b, "block_size": bs},
                     )
+                if self._prefix is not None:
+                    from consensusml_tpu.serve.pool.stages import (
+                        prefix_prefill_cost_args,
+                    )
+
+                    for b in self.buckets:
+                        name = f"serve.draft_prefix_prefill.b{b}"
+                        rows[name] = ledger.register(
+                            name, self._draft_prefix_prefill_fn, dparams,
+                            dpages,
+                            *prefix_prefill_cost_args(
+                                b, bs, self._pool.blocks_per_slot
+                            ),
+                            meta={
+                                **spec_meta, "bucket": b, "block_size": bs,
+                            },
+                        )
                 rows["serve.spec.propose"] = ledger.register(
                     "serve.spec.propose", self._propose_fn, dparams,
                     dpages,
@@ -953,6 +1133,26 @@ class Engine:
                     else 0.0
                 ),
             }
+            # on every paged engine (prefix-off baselines report the
+            # same field): padded tokens the prefill executables
+            # actually computed — the number prefix sharing shrinks
+            out["prefill_tokens_computed"] = self._prefill_tokens_computed
+            if self._prefix is not None:
+                lookups = self._prefix_hits + self._prefix_misses
+                out["prefix_cache"] = {
+                    "hits": self._prefix_hits,
+                    "misses": self._prefix_misses,
+                    "hit_rate": (
+                        self._prefix_hits / lookups if lookups else 0.0
+                    ),
+                    "hit_blocks": self._prefix_hit_blocks,
+                    "cow_copies": self._prefix_cow_copies,
+                    "bytes_saved": self._prefix_bytes_saved,
+                    "entries": len(self._prefix),
+                    "indexed_blocks": self._prefix.indexed_blocks,
+                    "shared_blocks": self._pool.shared_blocks,
+                    "invalidations": self._prefix.invalidations,
+                }
         if self.spec is not None:
             out["spec"] = {
                 "k": self.spec.k,
@@ -1025,7 +1225,7 @@ class Engine:
                     # settle block-seconds for the wide event; the pool
                     # itself is NOT released here (unchanged: the
                     # process is exiting, nothing re-admits)
-                    slot.request.block_seconds += self._pool.block_seconds(i)
+                    self._settle_block_seconds(slot.request, i)
                 self._finish_handle(
                     slot.request, slot.request.handle._all, "cancelled"
                 )
@@ -1067,13 +1267,26 @@ class Engine:
                 req = self._pop_waiting()
             except queue.Empty:
                 return
+            plan = None
             if self.paged:
                 from consensusml_tpu.serve.pool import blocks_for_tokens
 
-                bucket = self._bucket(len(req.ids))
-                need = blocks_for_tokens(
-                    len(req.ids) + 1, self.config.block_size
-                )
+                # the prefix plan is re-derived on EVERY attempt — a
+                # deferred tick may see matched blocks recycled (or new
+                # ones indexed) in the meantime, and the capacity/budget
+                # charge below must match the plan that will actually run
+                plan = self._prefix_plan(req)
+                if plan is None:
+                    bucket = self._bucket(len(req.ids))
+                    need = blocks_for_tokens(
+                        len(req.ids) + 1, self.config.block_size
+                    )
+                else:
+                    # charge only what the prefix path consumes: fresh
+                    # pops + free-list revivals of cached matched
+                    # blocks, and the SUFFIX bucket against the budget
+                    bucket = plan["bucket"]
+                    need = plan["free_needed"]
                 # defer (don't drop) when this tick's prefill budget is
                 # spent or the pool can't hold the prompt yet; the
                 # request keeps its place at the head of the line —
@@ -1091,7 +1304,7 @@ class Engine:
                     )
                     self._requeue.appendleft(req)
                     return
-            self._admit(req)
+            self._admit(req, plan)
 
     @staticmethod
     def _rid(req) -> str | None:
@@ -1105,19 +1318,72 @@ class Engine:
                 return b
         raise ValueError(f"prompt length {n} exceeds max bucket {self.buckets[-1]}")
 
-    def _admit(self, req) -> None:
+    def _prefix_plan(self, req) -> dict | None:
+        """Resolve ``req``'s admission against the prefix index: None =
+        run the full-causal prefill (index off, or nothing matched).
+        Otherwise a plan naming the blocks to adopt, the copy-on-write
+        source (a FULL match diverges inside its last block: the slot
+        re-points at a fresh page, the jit copies the shared rows over,
+        and only the final token recomputes), the suffix start/length,
+        and the free-block cost (fresh pops + revivals of matched blocks
+        currently parked on the free list)."""
+        if self._prefix is None:
+            return None
+        from consensusml_tpu.serve.pool import blocks_for_tokens
+
+        n = len(req.ids)
+        match = self._prefix.lookup(req.tenant, self._generation, req.ids)
+        if not match:
+            return None
+        bs = self.config.block_size
+        full = len(match) * bs == n
+        if full:
+            # every prompt block is indexed; the admission still needs
+            # last-token logits to sample from, and that recompute's
+            # K/V write lands INSIDE the final matched block, which
+            # other holders share — so the final block becomes the COW
+            # pair and only the prefix before it is adopted outright.
+            # (The first decode write at position n opens a fresh block
+            # — n % bs == 0 on a full match — so it never collides.)
+            adopted = match[:-1]
+            cow_src = match[-1]
+            start = n - 1
+        else:
+            adopted = match
+            cow_src = None
+            start = len(match) * bs
+        suffix_len = n - start
+        total = blocks_for_tokens(n + 1, bs)
+        fresh = total - len(adopted)
+        revive = sum(
+            1 for b in adopted if self._pool.refcount(b) == 0
+        )
+        if cow_src is not None and self._pool.refcount(cow_src) == 0:
+            revive += 1
+        return {
+            "match": match,
+            "adopted": adopted,
+            "cow_src": cow_src,
+            "start": start,
+            "suffix_len": suffix_len,
+            "bucket": self._bucket(suffix_len),
+            "fresh": fresh,
+            "free_needed": fresh + revive,
+        }
+
+    def _admit(self, req, plan=None) -> None:
         """Prefill ``req`` into a free slot (admission = one bucketed
         forward that seeds the slot cache and the first token). A raise
         mid-admission cancels THIS request's handle before propagating —
         at that point it is out of the queue but not yet in the slot
         table, so neither of the loop's exit sweeps would reach it."""
         try:
-            self._admit_inner(req)
+            self._admit_inner(req, plan)
         except BaseException:
             self._finish_handle(req, req.handle._all, "cancelled")
             raise
 
-    def _admit_inner(self, req) -> None:
+    def _admit_inner(self, req, plan=None) -> None:
         import jax.numpy as jnp
 
         from consensusml_tpu.serve.batcher import Slot
@@ -1125,19 +1391,27 @@ class Engine:
         idx = self._table.free_slot()
         assert idx is not None, "admission with no free slot"
         n = len(req.ids)
-        bucket = self._bucket(n)
+        kind = "prefix" if plan is not None else "full"
+        bucket = plan["bucket"] if plan is not None else self._bucket(n)
         # an evicted continuation re-prefills prompt + generated-so-far;
         # its TTFT already happened and its token count keeps running
         already = len(req.handle._all)
         # every admission's bucket feeds the wide event's cost join —
         # a continuation re-prefills (a real forward) into a possibly
-        # larger bucket, and each one is paid for
+        # larger bucket, and each one is paid for. The kind picks which
+        # ledger row the bucket joins (full vs prefix executable).
         req.prefill_buckets.append(bucket)
+        req.prefill_kinds.append(kind)
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.ids
+        if plan is not None:
+            ids[0, : plan["suffix_len"]] = req.ids[plan["start"] :]
+        else:
+            ids[0, :n] = req.ids
         self._rt.event(
             self._rid(req), "admission", slot=idx, bucket=bucket,
-            continuation=bool(already),
+            continuation=bool(already), prefix_blocks=(
+                len(plan["match"]) if plan is not None else 0
+            ),
         )
         t0 = time.perf_counter()
         samp = (
@@ -1146,9 +1420,14 @@ class Engine:
             jnp.uint32(req.seed),
         )
         with self._tracer.span("serve.prefill", bucket=bucket, slot=idx):
-            if self.paged:
+            if self.paged and plan is not None:
+                tok_dev = self._prefix_admit(idx, req, plan, bucket, ids, samp)
+            elif self.paged:
                 from consensusml_tpu.serve.pool import blocks_for_tokens
 
+                if self._prefix is not None:
+                    self._prefix_misses += 1
+                    self._m_prefix_misses.inc()
                 bs = self.config.block_size
                 # cover the prompt AND the first decode write (position n)
                 self._pool.alloc(idx, blocks_for_tokens(n + 1, bs))
@@ -1188,6 +1467,26 @@ class Engine:
                     *samp,
                 )
             tok = int(tok_dev)  # device fence: the first token is real now
+        if self.paged:
+            # target-model tokens the prefill executable computed (the
+            # padded bucket — what the device actually ran); prefix hits
+            # shrink this to the suffix bucket
+            self._prefill_tokens_computed += bucket
+            if self._prefix is not None:
+                # index this admission's full PROMPT chunks only —
+                # positions a PREFILL trace wrote. A continuation's
+                # decode-generated tokens stay unindexed: decode-written
+                # K/V is only bit-identical to itself, and the index
+                # must never serve bytes a fresh full prefill would not
+                # reproduce exactly. First writer wins, so a hit
+                # admission re-asserts its adopted entries at zero cost.
+                self._prefix.insert(
+                    req.tenant, self._generation,
+                    req.ids[: req.handle.prompt_len],
+                    self._pool.owned(idx),
+                )
+                self._m_prefix_entries.set(len(self._prefix))
+                self._m_prefix_shared_blocks.set(self._pool.shared_blocks)
         now = time.perf_counter()
         rid = self._rid(req)
         self._m_prefill.observe(now - t0, exemplar=rid)
@@ -1211,7 +1510,7 @@ class Engine:
         if already + 1 >= req.max_new_tokens or tok == req.eos_id:
             reason = "eos" if tok == req.eos_id else "max_tokens"
             if self.paged:
-                req.block_seconds += self._pool.block_seconds(idx)
+                self._settle_block_seconds(req, idx)
                 self._pool.release(idx)
             self._finish_handle(req, req.handle._all, reason, ttft=ttft)
             return
@@ -1222,6 +1521,95 @@ class Engine:
                 ttft_s=ttft, last_token_t=now, generation=self._generation,
             ),
         )
+
+    def _settle_block_seconds(self, req, idx) -> None:
+        """Fold slot ``idx``'s hold-time integral onto ``req`` before
+        its references go back: unshared hold is CHARGED to the request
+        (the wide event's block_seconds), prefix-shared hold is
+        attributed separately (shared_block_seconds) — a request never
+        pays for blocks the cache kept alive anyway."""
+        unshared, shared = self._pool.block_seconds_split(idx)
+        req.block_seconds += unshared
+        req.shared_block_seconds += shared
+
+    def _prefix_admit(self, idx, req, plan, bucket, ids, samp):
+        """Run one prefix-hit admission's device work: adopt the
+        matched blocks, pop fresh ones for the suffix, and dispatch the
+        suffix-window prefill (plus the draft's, on a spec engine —
+        draft pages share the block geometry, so the hit skips the
+        draft prefill too). Returns the sampled first-token device
+        value; on a raise the slot's references are fully unwound."""
+        import jax.numpy as jnp
+
+        from consensusml_tpu.serve.pool import TRASH_BLOCK
+
+        bs = self.config.block_size
+        pool = self._pool
+        pool.begin(idx)  # outside the unwind: a double-alloc raise here
+        pinned = None  # must not release the EXISTING owner's blocks
+        try:
+            pool.adopt(idx, plan["adopted"])
+            if plan["cow_src"] is not None:
+                # hold the source across the dispatch: the extend below
+                # must not pop it off the free list (a cached-free
+                # match) and hand it out as this slot's "fresh" page
+                pool.pin(plan["cow_src"])
+                pinned = plan["cow_src"]
+            fresh = pool.extend(idx, plan["fresh"])
+            if plan["cow_src"] is not None:
+                cow_src, cow_dst = plan["cow_src"], fresh[0]
+            else:
+                cow_src = cow_dst = TRASH_BLOCK
+            row = jnp.asarray(
+                pool.block_row(idx, pool.blocks_per_slot + bucket // bs)
+            )
+            tok_dev, _logits, self._pages = self._prefix_prefill_fn(
+                self._params,
+                self._pages,
+                jnp.asarray(ids),
+                jnp.int32(plan["suffix_len"]),
+                jnp.int32(plan["start"]),
+                row,
+                jnp.int32(cow_src),
+                jnp.int32(cow_dst),
+                *samp,
+            )
+            if self.spec is not None:
+                _dt, _dl, self._draft_pages = self._draft_prefix_prefill_fn(
+                    self._draft_params,
+                    self._draft_pages,
+                    jnp.asarray(ids),
+                    jnp.int32(plan["suffix_len"]),
+                    jnp.int32(plan["start"]),
+                    row,
+                    jnp.int32(cow_src),
+                    jnp.int32(cow_dst),
+                    *samp,
+                )
+        except BaseException:
+            if pinned is not None:
+                pool.unpin(pinned)
+            pool.release(idx)  # no leaked references on a raise
+            raise
+        if pinned is not None:
+            # the dispatch is in the device stream; any later write to
+            # the source block is ordered after this read completes
+            pool.unpin(pinned)
+        hit_blocks = len(plan["match"])
+        req.prefix_hit_blocks += hit_blocks
+        self._prefix_hits += 1
+        self._m_prefix_hits.inc()
+        self._prefix_hit_blocks += hit_blocks
+        self._m_prefix_hit_blocks.inc(hit_blocks)
+        if plan["cow_src"] is not None:
+            self._prefix_cow_copies += 1
+            self._m_prefix_cow_copies.inc()
+        saved = hit_blocks * self._block_nbytes
+        if self.spec is not None:
+            saved += hit_blocks * self._draft_block_nbytes
+        self._prefix_bytes_saved += saved
+        self._m_prefix_bytes_saved.inc(saved)
+        return tok_dev
 
     def _youngest_active(self) -> int:
         """Eviction victim: the most recently arrived stream (it has the
@@ -1240,7 +1628,7 @@ class Engine:
         req = slot.request
         # settle the hold-time integral before the blocks go back; the
         # re-admission restarts the clock on a fresh allocation
-        req.block_seconds += self._pool.block_seconds(idx)
+        self._settle_block_seconds(req, idx)
         self._pool.release(idx)
         # req.ids may itself be a continuation; the first prompt_len ids
         # are always the original prompt
@@ -1395,7 +1783,7 @@ class Engine:
             if reason is not None:
                 self._table.release(i)
                 if self.paged:
-                    req.block_seconds += self._pool.block_seconds(i)
+                    self._settle_block_seconds(req, i)
                     self._pool.release(i)
                 self._finish_handle(
                     req, req.handle._all, reason,
@@ -1572,10 +1960,23 @@ class Engine:
         joined = ledger is not None
         if ledger is not None:
             rows = []
-            for b in req.prefill_buckets:
-                rows.append(ledger.row(f"serve.prefill.b{b}"))
+            # kinds parallel the buckets: a prefix-hit admission joins
+            # the SUFFIX bucket's prefix-prefill row — its actual
+            # executable — not the full prefill's (requests minted
+            # outside submit() may predate the kinds list; default full)
+            kinds = req.prefill_kinds or ["full"] * len(req.prefill_buckets)
+            for b, kind in zip(req.prefill_buckets, kinds):
+                stem = (
+                    "serve.prefix_prefill" if kind == "prefix"
+                    else "serve.prefill"
+                )
+                rows.append(ledger.row(f"{stem}.b{b}"))
                 if self.spec is not None:
-                    rows.append(ledger.row(f"serve.draft_prefill.b{b}"))
+                    dstem = (
+                        "serve.draft_prefix_prefill" if kind == "prefix"
+                        else "serve.draft_prefill"
+                    )
+                    rows.append(ledger.row(f"{dstem}.b{b}"))
             if self.spec is not None:
                 step_rows = [
                     ledger.row("serve.spec.propose"),
@@ -1641,7 +2042,12 @@ class Engine:
             "generation": generation,
             "spec_proposed": req.spec_proposed,
             "spec_accepted": req.spec_accepted,
+            # block_seconds charges only EXCLUSIVE holds; prefix-shared
+            # hold time is attributed separately so N streams over one
+            # system prompt don't each pay for the same blocks
             "block_seconds": round(req.block_seconds, 6),
+            "shared_block_seconds": round(req.shared_block_seconds, 6),
+            "prefix_hit_blocks": req.prefix_hit_blocks,
             "attn_impl": self.attn_impl,
             "kv_impl": self.config.kv_impl,
             "prefill_buckets": list(req.prefill_buckets),
@@ -1688,6 +2094,8 @@ class Engine:
                 spec_accepted=req.spec_accepted,
                 tenant=req.tenant,
                 block_seconds=req.block_seconds,
+                shared_block_seconds=req.shared_block_seconds,
+                prefix_hit_blocks=req.prefix_hit_blocks,
             )
         )
         self._rt.finish(

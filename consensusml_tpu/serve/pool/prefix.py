@@ -1,0 +1,149 @@
+"""Content-addressed prefix index over paged KV blocks (host side).
+
+The engine's shared-prefix fast path: identical block-aligned prompt
+prefixes (system prompts, per-tenant templates, a preempted stream's own
+history) are prefilled ONCE and every later request maps the matched
+physical blocks straight into its slot's block table via
+``BlockPool.adopt`` — prefill then computes only the unshared suffix.
+vLLM's hash-block prefix caching and SGLang's RadixAttention are the
+reference designs; this index takes the hash-chain form because the
+pool's pages are already fixed-size blocks.
+
+**Hash scheme.** For a token sequence the index derives one digest per
+FULL block chunk: ``digest_i = sha256(tokens[0 : (i+1) * block_size])``
+— a running hash over the whole prefix, so a chunk's key commits to
+everything before it and two sequences share an entry only if they
+share the entire aligned prefix (no per-chunk collisions across
+different histories). Keys are ``(tenant, generation, digest)``:
+
+- ``tenant`` namespaces the index — tenant A's prompt bytes never map
+  into tenant B's table, even for identical token ids (cross-tenant KV
+  timing/communication isolation, PR 16's accounting boundary).
+- ``generation`` is the hot-swap weight generation; entries minted
+  under old weights are unreachable BY CONSTRUCTION after a swap
+  (lookups key on the current generation), and ``drop_stale`` garbage-
+  collects them at swap time.
+
+**Residency.** An entry maps a digest to a physical block id, not to a
+snapshot of its bytes — validity is maintained eagerly: the pool fires
+``reuse_hook`` (:meth:`invalidate_block`) the moment a fresh pop is
+about to recycle a block, so any id still present in the index holds
+exactly the bytes its digest names. A FREE block can therefore stay
+indexed (nothing scatters into free blocks — free lanes write the trash
+block) and adoption revives it off the free list; ``cached_hook``
+(:meth:`cached`) parks such blocks at the bottom of the free stack so
+they are recycled last.
+
+The index is engine-thread-only host state, like ``BlockPool``: pure
+dict lookups at admission, never inside the jit, zero device syncs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["PrefixIndex"]
+
+
+class PrefixIndex:
+    """Maps ``(tenant, generation, chain-digest)`` -> physical block."""
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self.block_size = block_size
+        self._chains: dict[tuple, int] = {}
+        self._by_block: dict[int, set[tuple]] = {}
+        self.invalidations = 0  # entries dropped by block reuse
+
+    def __len__(self) -> int:
+        return len(self._chains)
+
+    @property
+    def indexed_blocks(self) -> int:
+        """Distinct physical blocks the index currently references."""
+        return len(self._by_block)
+
+    def _digests(self, ids) -> list[bytes]:
+        """One running-hash digest per FULL block chunk of ``ids``
+        (partial tail chunks are never indexed — their bytes keep
+        changing as the stream decodes)."""
+        bs = self.block_size
+        n_full = len(ids) // bs
+        if n_full == 0:
+            return []
+        h = hashlib.sha256()
+        out = []
+        for i in range(n_full):
+            chunk = np.asarray(ids[i * bs : (i + 1) * bs], np.int64)
+            h.update(chunk.tobytes())
+            out.append(h.digest())
+        return out
+
+    def lookup(self, tenant: str, generation: int, ids) -> list[int]:
+        """Longest indexed block-aligned prefix of ``ids`` under
+        ``(tenant, generation)``: the physical block per matched chunk,
+        in order, stopping at the first miss. Empty list = no match."""
+        out: list[int] = []
+        for d in self._digests(ids):
+            b = self._chains.get((tenant, generation, d))
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def insert(self, tenant: str, generation: int, ids, blocks) -> int:
+        """Index ``ids``'s full block chunks against the physical blocks
+        that hold them (``blocks[i]`` holds chunk ``i`` — the admitting
+        slot's owned list). First writer wins: an existing entry keeps
+        its block (the new holder adopted it anyway on the hit path, and
+        on a near-miss re-prefill both copies hold identical bytes).
+        Returns the number of NEW entries."""
+        added = 0
+        for i, d in enumerate(self._digests(ids)):
+            if i >= len(blocks):
+                break
+            key = (tenant, generation, d)
+            if key in self._chains:
+                continue
+            b = int(blocks[i])
+            self._chains[key] = b
+            self._by_block.setdefault(b, set()).add(key)
+            added += 1
+        return added
+
+    def invalidate_block(self, block: int) -> int:
+        """Forget every entry naming ``block`` (wired as the pool's
+        ``reuse_hook``: the block's bytes are about to be overwritten).
+        Returns the number of entries dropped."""
+        keys = self._by_block.pop(int(block), None)
+        if not keys:
+            return 0
+        for k in keys:
+            self._chains.pop(k, None)
+        self.invalidations += len(keys)
+        return len(keys)
+
+    def cached(self, block: int) -> bool:
+        """True if ``block``'s bytes are still named by the index
+        (wired as the pool's ``cached_hook`` — freed-but-indexed blocks
+        park at the bottom of the free stack)."""
+        return int(block) in self._by_block
+
+    def drop_stale(self, current_generation: int) -> int:
+        """Garbage-collect entries minted under any OTHER weight
+        generation (hot-swap invalidation). Stale entries were already
+        unreachable — lookups key on the current generation — so this
+        only reclaims index memory and lets the pool stop treating
+        their blocks as cached. Returns the number dropped."""
+        stale = [k for k in self._chains if k[1] != current_generation]
+        for k in stale:
+            b = self._chains.pop(k)
+            keys = self._by_block.get(b)
+            if keys is not None:
+                keys.discard(k)
+                if not keys:
+                    del self._by_block[b]
+        return len(stale)

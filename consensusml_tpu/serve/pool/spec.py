@@ -37,6 +37,16 @@ same way. Overflow positions near ``max_len`` route through the
 engine's trash-padded block-table columns
 (:meth:`~consensusml_tpu.serve.pool.blocks.BlockPool.device_table`).
 
+Prefix sharing composes for free: the draft's pages mirror the pool's
+block GEOMETRY (same physical ids, same offsets), so when an admission
+adopts indexed prefix blocks the draft adopts them too — the engine
+runs the draft's prefix-prefill over the same block row, and both
+models skip the shared prompt (``serve/pool/prefix.py``). Spec writes
+land at positions ≥ the committed length, never inside a shared prompt
+block, and the engine's lazy shrink only pops the owned TAIL — the
+refcounted pool (``blocks.py``) keeps shared front blocks alive until
+their last holder releases.
+
 Both executables are step-over-step jaxpr-contract-pinned
 (``analysis/jaxpr_contracts.py``: no host callbacks, no f64, canonical
 hash stable across sampled ticks) and registered in the cost ledger

@@ -33,8 +33,10 @@ from typing import Any, Callable
 __all__ = [
     "make_paged_prefill_fn",
     "make_paged_decode_fn",
+    "make_prefix_prefill_fn",
     "prefill_cost_args",
     "decode_cost_args",
+    "prefix_prefill_cost_args",
     "AdmissionScheduler",
 ]
 
@@ -131,6 +133,106 @@ def make_paged_prefill_fn(dm: Any) -> Callable:
         return tok, last, new_pages
 
     return jax.jit(prefill, donate_argnums=_donate_cache())
+
+
+def prefix_prefill_cost_args(
+    bucket: int, block_size: int, blocks_per_slot: int
+) -> tuple:
+    """Abstract non-tree arguments of one prefix-prefill invocation at
+    suffix bucket ``bucket`` — ``(ids, suffix_len, start_pos, block_row,
+    cow_src, cow_dst, temperature, top_p, seed)`` shape structs for the
+    cost ledger's AOT lowering. The block row spans the slot's full
+    table width plus ``bucket // block_size`` trash overflow columns
+    (see :func:`make_prefix_prefill_fn`)."""
+    import jax
+    import jax.numpy as jnp
+
+    cols = blocks_per_slot + bucket // block_size
+    return (
+        jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((cols,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.uint32),
+    )
+
+
+def make_prefix_prefill_fn(dm: Any, attn_impl: str = "gather") -> Callable:
+    """``prefix_prefill(params, pages, ids (1, B), suffix_len,
+    start_pos, block_row (cols,), cow_src, cow_dst, temperature, top_p,
+    seed)`` -> ``(first_token, last_logits (V,), new_pages)``.
+
+    The prefix-cache admission stage: the matched prefix is ALREADY in
+    the slot's pool blocks (adopted from the index), so only the
+    unshared suffix — ``ids[0, :suffix_len]`` at absolute positions
+    ``start_pos + i``, right-padded to bucket ``B`` — runs the forward.
+    This reuses the speculative verify's window machinery
+    (2-D positions → ``paged_update_kv_cache_window`` +
+    windowed paged attention): each suffix token's K/V scatters to
+    ``block_row[pos // bs]`` and its query attends the gathered pages
+    under the mask ``key_pos <= pos``, which reads the adopted prefix
+    KV bit-exactly as the full causal prefill would have recomputed it.
+
+    Where the split is mid-prefix (a FULL-match hit recomputing only the
+    last token, or a future partial-block split), the slot's first write
+    would land in a block other streams still share; ``cow_src`` /
+    ``cow_dst`` resolve that copy-on-write INSIDE the jit
+    (:func:`consensusml_tpu.models.attention.paged_cow_copy`): the shared
+    source block's rows copy to the slot's fresh block BEFORE the window
+    scatter, and ``block_row`` already names the fresh block — no host
+    sync, no cache read-back. Passing ``cow_src == cow_dst == 0`` (the
+    trash block) disables the copy (a trash self-copy is a benign no-op
+    lane, same trick as the decode scatter's free lanes).
+
+    One executable per SUFFIX bucket ``B`` — the same bucket ladder the
+    full prefill compiles, so prefix splits change which executable runs,
+    never its shape (zero-recompile contract). ``block_row`` carries
+    ``B // block_size`` extra trash columns beyond ``blocks_per_slot``:
+    bucket pad positions past the real suffix can reach
+    ``start_pos + B - 1``, and ``pos // bs`` must resolve past-the-row
+    chunks to trash instead of index-clamping into the slot's last owned
+    block (same overflow guard as ``spec_table_cols``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.models.attention import paged_cow_copy
+    from consensusml_tpu.serve.decode import _donate_cache
+    from consensusml_tpu.serve.sampling import sample_token
+
+    model = dm.model
+
+    def prefix_prefill(
+        params, pages, ids, suffix_len, start_pos, block_row,
+        cow_src, cow_dst, temperature, top_p, seed,
+    ):
+        pages = [paged_cow_copy(pg, cow_src, cow_dst) for pg in pages]
+        b = ids.shape[1]
+        pos = start_pos + jnp.arange(b, dtype=jnp.int32)[None, :]
+        logits, new_pages = model.apply(
+            {"params": params},
+            ids,
+            deterministic=True,
+            positions=pos,
+            kv_cache=pages,
+            block_table=block_row[None, :],
+            attn_impl=attn_impl,
+        )
+        last = logits[0, suffix_len - 1]  # (V,) — last REAL suffix token
+        fold = start_pos + suffix_len - 1  # absolute position n - 1:
+        # the SAME fold key the full prefill derives, so sampled streams
+        # stay bit-identical whichever admission path ran
+        tok = sample_token(
+            last[None], temperature[None], top_p[None], seed[None],
+            fold[None],
+        )[0]
+        return tok, last, new_pages
+
+    return jax.jit(prefix_prefill, donate_argnums=_donate_cache())
 
 
 def make_paged_decode_fn(dm: Any, attn_impl: str = "gather") -> Callable:

@@ -1,0 +1,464 @@
+"""Refcounted prefix-block sharing + copy-on-write (ISSUE 18).
+
+The pinned properties:
+
+- **Refcount soundness** — under randomized alloc/adopt/pin/extend/
+  shrink/release churn the free list and the slot-owned multiset
+  partition the physical blocks exactly (``BlockPool.check()`` after
+  every op), and everything drains back to a full free list.
+- **Index semantics** — the content-addressed index keys on
+  ``(tenant, generation, running-hash)``: chained digests make a match
+  position-dependent, tenants never see each other's blocks, stale
+  generations drop at hot-swap, and block reuse eagerly invalidates.
+- **Bit-exact parity** — the SAME prompts through a prefix-cache-on
+  engine and a prefix-cache-off engine produce identical token streams
+  (both model families, greedy and sampled, speculative and plain),
+  with zero recompiles after warmup: one prefix-prefill executable per
+  SUFFIX bucket.
+- **Divergence + pressure** — a full-match admission copies-on-write
+  instead of mutating the shared block; recompute-preempted streams
+  re-admit THROUGH the cache and still finish token-identical to a
+  never-evicting engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensusml_tpu.serve import Engine, ServeConfig, SpecConfig
+from consensusml_tpu.serve import pool as P
+
+pytestmark = pytest.mark.serving
+
+
+def _tiny_gpt2():
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+
+    return GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=32,
+            dropout=0.0,
+        )
+    )
+
+
+def _tiny_llama():
+    from consensusml_tpu.models.llama import llama_tiny
+
+    return llama_tiny(max_len=32)
+
+
+def _init(model, seed=0):
+    return model.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# BlockPool refcounts under churn
+# ---------------------------------------------------------------------------
+
+
+def test_refcounted_pool_randomized_churn_with_sharing():
+    """Randomized alloc/adopt/pin/extend/shrink/release churn with
+    check() after EVERY op: the free list and the Σ slot-owned multiset
+    (plus pins) partition the physical blocks at all times, shared
+    blocks survive their first releaser, and the pool drains clean."""
+    rng = np.random.default_rng(0)
+    pool = P.BlockPool(num_slots=6, max_len=32, block_size=4, num_blocks=24)
+    live: set[int] = set()
+    pinned: list[int] = []
+    adopts = 0
+    for step in range(400):
+        op = rng.integers(0, 6)
+        if op == 0 and len(live) < pool.num_slots:  # fresh admission
+            slot = next(s for s in range(pool.num_slots) if s not in live)
+            pool.begin(slot)
+            donor = int(rng.choice(sorted(live))) if live else None
+            if donor is not None and rng.random() < 0.6:
+                owned = pool.owned(donor)
+                k = int(
+                    rng.integers(1, min(len(owned), pool.blocks_per_slot - 2) + 1)
+                )
+                before = [pool.refcount(b) for b in owned[:k]]
+                pool.adopt(slot, owned[:k])
+                adopts += 1
+                for b, r in zip(owned[:k], before):
+                    assert pool.refcount(b) == r + 1
+            try:
+                pool.extend(slot, int(rng.integers(1, 3)))
+            except P.NoFreeBlocks:
+                pool.release(slot)
+            else:
+                live.add(slot)
+        elif op == 1 and live:  # grow
+            slot = int(rng.choice(sorted(live)))
+            if len(pool.owned(slot)) < pool.blocks_per_slot:
+                try:
+                    pool.extend(slot)
+                except P.NoFreeBlocks:
+                    pass
+        elif op == 2 and live:  # shrink toward the head
+            slot = int(rng.choice(sorted(live)))
+            n = len(pool.owned(slot))
+            pool.shrink(slot, int(rng.integers(1, n + 1)))
+        elif op == 3 and live:  # pin a shared-candidate block
+            slot = int(rng.choice(sorted(live)))
+            b = int(rng.choice(pool.owned(slot)))
+            pool.pin(b)
+            pinned.append(b)
+        elif op == 4 and pinned:
+            pool.unpin(pinned.pop(int(rng.integers(0, len(pinned)))))
+        elif op == 5 and live:  # terminal release
+            slot = int(rng.choice(sorted(live)))
+            pool.release(slot)
+            live.discard(slot)
+        pool.check()
+    assert adopts > 0, "churn never exercised sharing"
+    for b in pinned:
+        pool.unpin(b)
+    for slot in sorted(live):
+        pool.release(slot)
+    pool.check()
+    assert pool.free_blocks == pool.usable_blocks
+    assert pool.shared_blocks == 0
+
+
+def test_pool_adopt_and_pin_reject_misuse():
+    pool = P.BlockPool(num_slots=2, max_len=16, block_size=4, num_blocks=9)
+    blocks = pool.alloc(0, 2)
+    with pytest.raises(RuntimeError):  # adopt without begin()
+        pool.adopt(1, blocks)
+    pool.begin(1)
+    with pytest.raises(ValueError):  # the trash block is never adoptable
+        pool.adopt(1, [P.TRASH_BLOCK])
+    pool.adopt(1, blocks[:1])
+    assert pool.refcount(blocks[0]) == 2
+    assert pool.shared_blocks == 1
+    with pytest.raises(RuntimeError):  # double-adopt of a held block
+        pool.adopt(1, blocks[:1])
+    # adopting a FREE in-range block is the legal revive path (a cached
+    # prefix block coming back off the free list)
+    parked = pool._free[-1]
+    pool.adopt(1, [parked])
+    assert pool.refcount(parked) == 1 and parked not in pool._free
+    with pytest.raises(RuntimeError):  # unpin without pin
+        pool.unpin(blocks[1])
+    # releasing the original owner keeps the shared block alive
+    pool.release(0)
+    pool.check()
+    assert pool.refcount(blocks[0]) == 1
+    pool.release(1)
+    pool.check()
+    assert pool.free_blocks == pool.usable_blocks
+
+
+# ---------------------------------------------------------------------------
+# PrefixIndex content addressing
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_index_chain_tenant_generation_semantics():
+    idx = P.PrefixIndex(block_size=4)
+    ids = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]  # 2 full chunks + partial tail
+    assert idx.lookup("a", 0, ids) == []
+    assert idx.insert("a", 0, ids, [7, 8, 11]) == 2  # tail never indexed
+    assert len(idx) == 2 and idx.indexed_blocks == 2
+    assert idx.lookup("a", 0, ids) == [7, 8]
+    # partial tails don't match; shorter aligned prefixes do
+    assert idx.lookup("a", 0, ids[:6]) == [7]
+    # running hash: same SECOND chunk behind a different first chunk
+    # must not match at position 2
+    other = [9, 9, 9, 9] + ids[4:8]
+    assert idx.lookup("a", 0, other) == []
+    # divergence inside chunk 2 stops the chain after chunk 1
+    div = ids[:4] + [9, 9, 9, 9]
+    assert idx.lookup("a", 0, div) == [7]
+    # tenant + generation namespacing
+    assert idx.lookup("b", 0, ids) == []
+    assert idx.lookup("a", 1, ids) == []
+    # first writer wins on re-insert
+    assert idx.insert("a", 0, ids, [20, 21]) == 0
+    assert idx.lookup("a", 0, ids) == [7, 8]
+    # block reuse eagerly invalidates just the entries naming it
+    assert idx.invalidate_block(8) == 1
+    assert idx.invalidations == 1
+    assert idx.lookup("a", 0, ids) == [7]
+    assert idx.cached(7) and not idx.cached(8)
+    # hot-swap: stale generations drop wholesale
+    idx.insert("a", 1, ids, [30, 31])
+    assert idx.drop_stale(1) == 1  # the surviving gen-0 entry
+    assert idx.lookup("a", 0, ids) == []
+    assert idx.lookup("a", 1, ids) == [30, 31]
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: prefix cache on vs off, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _serve_all(model, params, cfg, jobs, spec=None):
+    """Submit ``jobs`` (ids, max_new, kwargs) sequentially so later
+    shared-prefix jobs deterministically find the earlier insertions.
+    Returns (token streams, per-request hit blocks, stats, warm)."""
+    with Engine(model, params, cfg, spec_decode=spec) as eng:
+        warm = eng.warmup()
+        results = [
+            eng.submit(ids, max_new, **kw).result(timeout=120)
+            for ids, max_new, kw in jobs
+        ]
+        stats = eng.stats()
+        eng._pool.check()
+        assert stats["pool"]["free_blocks"] == stats["pool"]["usable_blocks"]
+    toks = [r.tokens for r in results]
+    hits = [r.prefix_hit_blocks for r in results]
+    return toks, hits, stats, warm
+
+
+# fast/slow tiering (tests/conftest.py, round-8): a prefix-on engine
+# pays ~2x warmup (one extra prefill executable per suffix bucket, plus
+# draft twins under spec), so every on-vs-off pair here costs 13-24s and
+# the fast tier has no room for five of them. The gpt2 bit-exact parity
+# run — the acceptance criterion itself: shared-prefix streams identical
+# on vs off, hit accounting pinned, zero recompiles — STAYS fast along
+# with the sub-second pool/index unit tests; the llama family twin, spec
+# composition, COW divergence, eviction re-admission, hot-swap
+# invalidation and tenant isolation ride the slow tier per the round-7
+# "≥10s with a sibling covering the surface" rule (the fast parity run
+# drives the same _prefix_plan/adopt/insert machinery end to end).
+@pytest.mark.parametrize(
+    "family",
+    ["gpt2", pytest.param("llama", marks=pytest.mark.slow)],
+)
+def test_engine_prefix_parity_bit_exact(family):
+    """Shared-prefix traffic (greedy AND sampled) through a prefix-on
+    engine matches the prefix-off engine token for token, while the hit
+    accounting shows the shared blocks were adopted, not recomputed."""
+    model = _tiny_gpt2() if family == "gpt2" else _tiny_llama()
+    vocab = model.config.vocab_size
+    params = _init(model)
+    rng = np.random.default_rng(18)
+    shared = rng.integers(0, vocab - 1, size=16).tolist()  # 2 full blocks
+    jobs = []
+    for i, n in enumerate((1, 3, 5, 8)):  # distinct unshared suffixes
+        suffix = rng.integers(0, vocab - 1, size=n).tolist()
+        kw = {} if i % 2 == 0 else {"temperature": 0.9, "seed": 100 + i}
+        jobs.append((shared + suffix, 6, kw))
+    jobs.append((rng.integers(0, vocab - 1, size=5).tolist(), 6, {}))
+
+    cfg = dict(num_slots=4, max_len=32, kv_impl="paged", block_size=8)
+    on, on_hits, on_stats, warm = _serve_all(
+        model, params, ServeConfig(prefix_cache=True, **cfg), jobs
+    )
+    off, off_hits, off_stats, _ = _serve_all(
+        model, params, ServeConfig(prefix_cache=False, **cfg), jobs
+    )
+    assert on == off
+    pc = on_stats["prefix_cache"]
+    # job 0 inserts the 2 shared chunks; jobs 1-3 adopt both
+    assert pc["hits"] == 3 and pc["hit_blocks"] == 6
+    assert pc["misses"] == 2  # job 0 and the unrelated prompt
+    assert on_hits == [0, 2, 2, 2, 0]
+    assert sum(on_hits) == pc["hit_blocks"]
+    assert off_stats.get("prefix_cache") is None and off_hits == [0] * 5
+    # prefix hits prefill only the SUFFIX bucket: fewer tokens computed
+    assert (
+        on_stats["prefill_tokens_computed"]
+        < off_stats["prefill_tokens_computed"]
+    )
+    # one executable per suffix bucket, all paid during warmup
+    assert on_stats["compile_counts"] == warm
+
+
+@pytest.mark.slow
+def test_engine_cow_on_full_match_divergence():
+    """A FULL-match admission (every prompt block indexed) diverges
+    inside its last block: the engine copies that block on write and
+    recomputes only the final token — streams stay bit-identical to the
+    prefix-off engine and the donor's blocks are never mutated."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 63, size=16).tolist()  # exactly 2 blocks
+    jobs = [(prompt, 6, {}), (prompt, 6, {}), (prompt, 6, {})]
+    cfg = dict(num_slots=4, max_len=32, kv_impl="paged", block_size=8)
+    on, _, on_stats, warm = _serve_all(
+        model, params, ServeConfig(prefix_cache=True, **cfg), jobs
+    )
+    off, _, _, _ = _serve_all(
+        model, params, ServeConfig(prefix_cache=False, **cfg), jobs
+    )
+    assert on == off
+    assert on[0] == on[1] == on[2]  # greedy: identical streams
+    pc = on_stats["prefix_cache"]
+    assert pc["hits"] == 2 and pc["cow_copies"] == 2
+    assert on_stats["compile_counts"] == warm
+
+
+@pytest.mark.slow
+def test_spec_decode_prefix_parity_bit_exact():
+    """Speculative decode (self-draft: acceptance 1.0) composes with the
+    prefix cache — draft pages share the pool's block table, so a hit
+    also skips the draft's shared prefill — and streams stay bit-exact
+    vs the prefix-off speculative engine."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, 63, size=16).tolist()
+    jobs = [
+        (shared + rng.integers(0, 63, size=n).tolist(), 6,
+         {"temperature": 1.2, "seed": 40 + n})
+        for n in (2, 4, 7)
+    ]
+    cfg = dict(num_slots=2, max_len=32, kv_impl="paged", block_size=8)
+    spec = SpecConfig(model=model, params=params, k=2)
+    on, _, on_stats, warm = _serve_all(
+        model, params, ServeConfig(prefix_cache=True, **cfg), jobs, spec=spec
+    )
+    off, _, _, _ = _serve_all(
+        model, params, ServeConfig(prefix_cache=False, **cfg), jobs,
+        spec=SpecConfig(model=model, params=params, k=2),
+    )
+    assert on == off
+    pc = on_stats["prefix_cache"]
+    assert pc["hits"] == 2 and pc["hit_blocks"] == 4
+    assert on_stats["spec"]["acceptance_rate"] == 1.0
+    assert on_stats["compile_counts"] == warm
+
+
+@pytest.mark.slow
+def test_preemption_readmission_through_prefix_cache():
+    """Recompute-preempted streams re-admit THROUGH the cache: the
+    shared prompt block is adopted at re-admission instead of being
+    re-prefilled, and the tight engine still finishes token-identical
+    to a never-evicting one."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    rng = np.random.default_rng(9)
+    shared = rng.integers(0, 63, size=8).tolist()  # 1 full block
+    prompts = [
+        shared + rng.integers(0, 63, size=n).tolist() for n in (2, 5, 8, 11)
+    ]
+    # peak PHYSICAL demand counts the shared block once: 1 shared +
+    # (2+2+3+3) unshared = 11 blocks vs the tight pool's 9 usable, and
+    # the lockstep decode batch reaches peak simultaneously — eviction
+    # pressure survives the very sharing this test exercises (max_new=6
+    # would not: sharing alone shrinks demand to fit, which is the perf
+    # story but not the re-admission one)
+    max_new = 10
+
+    def serve(num_blocks):
+        cfg = ServeConfig(
+            num_slots=4, max_len=32, kv_impl="paged", block_size=8,
+            num_blocks=num_blocks, prefix_cache=True,
+        )
+        with Engine(model, params, cfg) as eng:
+            eng.warmup()
+            handles = [eng.submit(p, max_new) for p in prompts]
+            results = [h.result(timeout=120) for h in handles]
+            stats = eng.stats()
+            eng._pool.check()
+            assert (
+                stats["pool"]["free_blocks"] == stats["pool"]["usable_blocks"]
+            )
+        return [r.tokens for r in results], stats
+
+    tight, tight_stats = serve(num_blocks=10)
+    roomy, roomy_stats = serve(num_blocks=0)
+    assert roomy_stats["evictions"] == 0
+    assert tight_stats["evictions"] > 0
+    assert tight == roomy
+    assert all(len(t) == max_new for t in tight)
+    # admissions after the first find the shared block (initial waves
+    # AND re-admitted continuations both resolve through the index)
+    assert tight_stats["prefix_cache"]["hits"] >= 1
+    assert roomy_stats["prefix_cache"]["hits"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# Invalidation boundaries: hot swap + tenants
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hot_swap_drops_stale_prefix_generation():
+    """A generation flip invalidates the whole index: entries minted
+    under the old weights are unreachable (lookups key on the live
+    generation) and drop_stale reclaims them at flip time, so the first
+    post-swap admission re-prefills from scratch."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    prompt = np.random.default_rng(2).integers(0, 63, size=16).tolist()
+    with Engine(
+        model, params,
+        ServeConfig(num_slots=2, max_len=32, kv_impl="paged",
+                    prefix_cache=True),
+    ) as eng:
+        eng.warmup()
+        eng.submit(prompt, 2).result(timeout=120)  # miss: inserts gen 0
+        eng.submit(prompt, 2).result(timeout=120)  # hit
+        pc = eng.stats()["prefix_cache"]
+        assert pc["hits"] == 1 and pc["entries"] == 2
+
+        from consensusml_tpu.serve.pool.hotswap import StagedSwap
+
+        class OneShotWatcher:
+            def __init__(self):
+                self.staged = StagedSwap(1, params, {})
+
+            def take(self):
+                sw, self.staged = self.staged, None
+                return sw
+
+            def reject(self, staged=None):
+                raise AssertionError("identical tree must flip")
+
+            def stop(self):
+                pass
+
+        eng._watcher = OneShotWatcher()
+        # the flip happens between decode steps; drive one throwaway
+        # request through so the loop observes the staged generation
+        eng.submit([1, 2, 3], 2).result(timeout=120)
+        deadline = 120
+        while eng.generation != 1 and deadline > 0:
+            import time as _t
+
+            _t.sleep(0.05)
+            deadline -= 1
+        assert eng.generation == 1
+        assert len(eng._prefix) == 0  # gen-0 entries dropped at flip
+        eng.submit(prompt, 2).result(timeout=120)  # stale gen: miss
+        eng.submit(prompt, 2).result(timeout=120)  # re-indexed: hit
+        pc = eng.stats()["prefix_cache"]
+    assert pc["hits"] == 2 and pc["misses"] == 3
+
+
+@pytest.mark.slow
+def test_cross_tenant_prefix_isolation():
+    """Identical prompts under different tenants never share cache
+    entries: tenant B's first admission is a MISS even though tenant A
+    already indexed the same bytes — while the served streams (a pure
+    function of the weights) stay identical across tenants."""
+    model = _tiny_gpt2()
+    params = _init(model)
+    prompt = np.random.default_rng(4).integers(0, 63, size=16).tolist()
+    with Engine(
+        model, params,
+        ServeConfig(num_slots=2, max_len=32, kv_impl="paged",
+                    prefix_cache=True),
+    ) as eng:
+        eng.warmup()
+        a1 = eng.submit(prompt, 4, tenant="acme").result(timeout=120)
+        a2 = eng.submit(prompt, 4, tenant="acme").result(timeout=120)
+        b1 = eng.submit(prompt, 4, tenant="bolt").result(timeout=120)
+        b2 = eng.submit(prompt, 4, tenant="bolt").result(timeout=120)
+        pc = eng.stats()["prefix_cache"]
+        eng._pool.check()
+    assert a1.tokens == a2.tokens == b1.tokens == b2.tokens
+    assert b1.prefix_hit_blocks == 0  # isolation: no cross-tenant hit
+    assert a2.prefix_hit_blocks == 2 and b2.prefix_hit_blocks == 2
+    assert pc["hits"] == 2 and pc["misses"] == 2
+    assert pc["entries"] == 4  # 2 chunks per tenant namespace

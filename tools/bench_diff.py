@@ -184,6 +184,30 @@ DEFAULT_SPEC = [
      "direction": "max", "bound": 60000.0},
     {"key": "attribution.compile_ms.spec_verify_fused",
      "direction": "max", "bound": 60000.0},
+    # prefix-cache block (ISSUE 18, docs/serving.md "Prefix sharing"):
+    # under the 90%-shared system-prompt mix the admission hit rate must
+    # clear its floor and hit admissions must actually skip prefill work
+    # (tokens-saved fraction vs the index-off twin at the same seed);
+    # TTFT p50 must never be SLOWER with the cache on (floor 1.0 — the
+    # measured speedup rides the archive trajectory); the engine stays
+    # zero-recompile after warmup with the prefix_prefill family
+    # compiled (one executable per SUFFIX bucket, whatever the hit
+    # pattern), and a workload that never hits pays under 1% of a p50
+    # request for the hash-and-miss
+    {"key": "serving.prefix_cache.shared.hit_rate", "direction": "min",
+     "bound": 0.5},
+    {"key": "serving.prefix_cache.prefill_tokens_saved_frac",
+     "direction": "min", "bound": 0.3},
+    {"key": "serving.prefix_cache.ttft_p50_speedup", "direction": "min",
+     "bound": 1.0},
+    {"key": "serving.prefix_cache.ttft_p50_speedup", "direction": "up",
+     "tol_pct": 30.0},
+    {"key": "serving.prefix_cache.shared.zero_recompiles_after_warmup",
+     "direction": "min", "bound": 1.0},
+    {"key": "serving.prefix_cache.zero_hit.hits", "direction": "max",
+     "bound": 0.0},
+    {"key": "serving.prefix_cache.zero_hit.overhead_pct",
+     "direction": "max", "bound": 1.0},
 ]
 
 

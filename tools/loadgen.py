@@ -102,6 +102,7 @@ def run_loadgen(
     temperature: float = 0.0,
     top_p: float = 1.0,
     tenants: list[tuple[str, float]] | None = None,
+    shared_prefix: tuple[int, float] | None = None,
     history=None,
     history_tick_s: float = 0.25,
 ) -> dict:
@@ -123,6 +124,17 @@ def run_loadgen(
     index)`` — like the trace ids — so a fixture replays to the SAME
     sampled token streams end to end (the engine's ``(seed, position)``
     fold keys make the stream a pure function of the request).
+
+    ``shared_prefix`` (``(len, frac)``, from ``--shared-prefix
+    LEN:FRAC``) models the system-prompt workload the serving prefix
+    cache (docs/serving.md "Prefix sharing") exists for: ONE fixed
+    ``len``-token prefix is drawn from the fixture rng up front, and
+    each arrival prepends it with probability ``frac`` (the remaining
+    arrivals stay fully random, so the run exercises hits and misses in
+    one mix). The draw is deterministic per seed — a replay offers the
+    identical hit pattern — and the sampled per-arrival length from
+    ``--prompt-len`` becomes the UNSHARED suffix length, which is what
+    the engine actually prefills on a hit.
 
     ``tenants`` (``[(name, weight), ...]``, from ``--tenants
     "a=3,b=1"``) assigns each arrival a tenant label by weighted draw
@@ -148,6 +160,19 @@ def run_loadgen(
 
     rng = np.random.default_rng(seed)
     lo, hi = prompt_lens
+    prefix_ids: list[int] = []
+    prefix_frac = 0.0
+    if shared_prefix is not None:
+        plen, prefix_frac = shared_prefix
+        if plen < 1 or not (0.0 < prefix_frac <= 1.0):
+            raise ValueError(
+                f"shared_prefix needs len >= 1 and 0 < frac <= 1, "
+                f"got {shared_prefix}"
+            )
+        # ONE fixed prefix per fixture seed: every sharing arrival
+        # offers the identical block-aligned chunks to the server's
+        # prefix index
+        prefix_ids = [int(t) for t in rng.integers(0, vocab - 1, size=plen)]
     metrics = _LoadgenMetrics(rate_rps, tenant_mode=bool(tenants))
     results: list[dict] = []
     errors: list[str] = []
@@ -203,7 +228,10 @@ def run_loadgen(
             swap_fn()
             swaps += 1
         n = sample_prompt_len(rng, lo, hi, len_dist)
-        ids = rng.integers(0, vocab - 1, size=n)
+        ids = [int(t) for t in rng.integers(0, vocab - 1, size=n)]
+        shared_arrival = bool(prefix_ids) and float(rng.random()) < prefix_frac
+        if shared_arrival:  # sampled length = the UNSHARED suffix
+            ids = prefix_ids + ids
         # deterministic trace identity (seed + arrival index): the same
         # fixture replays to the same ids, and client + server sides of
         # one request join on trace_id (docs/observability.md)
@@ -225,7 +253,7 @@ def run_loadgen(
         if tenant_names:
             sampling["tenant"] = tenant
         t = threading.Thread(
-            target=one, args=(list(map(int, ids)), ctx, sampling, tenant)
+            target=one, args=(ids, ctx, sampling, tenant)
         )
         threads.append(t)
         metrics.observe_issued()
@@ -287,6 +315,13 @@ def run_loadgen(
         "errors": len(errors),
         "error_sample": errors[:3],
         "len_dist": len_dist,
+        # the offered sharing mix (None without --shared-prefix); the
+        # server-side hit accounting is engine.stats()["prefix_cache"]
+        "shared_prefix": (
+            {"len": len(prefix_ids), "frac": prefix_frac}
+            if prefix_ids
+            else None
+        ),
         "temperature": temperature,
         "top_p": top_p,
         # speculative-decode roll-up (0/0 against a non-spec engine)
@@ -502,6 +537,12 @@ def main(argv=None) -> int:
                    help="artifact mode: serve speculatively with the "
                         "draft/ subartifact proposing K tokens per round "
                         "(serve.export.export_draft installs one)")
+    p.add_argument("--shared-prefix", default=None, metavar="LEN:FRAC",
+                   help="prepend ONE fixed LEN-token prefix (drawn once "
+                        "from the fixture seed) to FRAC of arrivals — "
+                        "the system-prompt mix the serving prefix cache "
+                        "deduplicates; --prompt-len then sizes the "
+                        "unshared suffix (docs/serving.md)")
     p.add_argument("--tenants", default=None, metavar="SPEC",
                    help="weighted tenant mix, e.g. 'a=3,b=1' (bare names "
                         "weight 1): each arrival draws a tenant label "
@@ -525,6 +566,10 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     lo, hi = (int(x) for x in args.prompt_len.split(":"))
+    shared_prefix = None
+    if args.shared_prefix:
+        plen, _, frac = args.shared_prefix.partition(":")
+        shared_prefix = (int(plen), float(frac) if frac else 1.0)
     engine = None
     swap_fn = None
     if args.artifact:
@@ -532,7 +577,13 @@ def main(argv=None) -> int:
 
         engine = load_engine(
             args.artifact,
-            ServeConfig(num_slots=args.slots, max_new_tokens=args.max_new),
+            ServeConfig(
+                num_slots=args.slots,
+                max_new_tokens=args.max_new,
+                # --shared-prefix load is only meaningful against the
+                # prefix index; plain runs keep the lean seed warmup
+                prefix_cache=shared_prefix is not None,
+            ),
             spec_k=args.spec_k,
         )
         engine.warmup()
@@ -585,6 +636,7 @@ def main(argv=None) -> int:
         temperature=args.temperature,
         top_p=args.top_p,
         tenants=parse_tenant_weights(args.tenants),
+        shared_prefix=shared_prefix,
         history=history,
     )
     if engine is not None:
