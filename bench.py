@@ -1792,9 +1792,10 @@ def _request_tracing_bench() -> dict:
 def _analysis_bench() -> dict:
     """Concurrency-correctness plane cost (docs/static_analysis.md):
     per-pass wall time of the cml-check AST passes — absolute budgets
-    gated by tools/bench_diff.py (<2 s each) — plus a lockdep sanitizer
-    fuzz smoke (<30 s budget) proving the runtime wrappers stay cheap
-    enough to ride tier-1."""
+    gated by tools/bench_diff.py (<2 s each; the model-checking pass
+    gets 30 s: exhaustive state-space search, not one AST walk) — plus
+    a lockdep sanitizer fuzz smoke (<30 s budget) proving the runtime
+    wrappers stay cheap enough to ride tier-1."""
     import importlib.util
     import threading
 
@@ -1806,7 +1807,10 @@ def _analysis_bench() -> dict:
     spec.loader.exec_module(cml)
     from consensusml_tpu.analysis import load_baseline, split_suppressed
 
-    passes = ["host-sync", "locks", "threads", "lockorder", "docs-drift"]
+    passes = [
+        "host-sync", "locks", "threads", "lockorder", "docs-drift",
+        "lifecycle", "model",
+    ]
     findings, timings = cml.run_passes(passes, cml.AST_PASS_PATHS)
     baseline = load_baseline(cml.DEFAULT_BASELINE)
     active, _suppressed, _stale = split_suppressed(findings, baseline)
@@ -1839,11 +1843,22 @@ def _analysis_bench() -> dict:
         fuzz_schedule([worker] * 4, seed=1, repeat=3)
     smoke_s = time.perf_counter() - t0
     assert shared.n == 4 * 300 * 3 and san.check() == []
+
+    # model-checker state-space size: reported so the bench archive
+    # shows growth when a model gains actions (the wall budget is the
+    # gate; the counts explain it)
+    from consensusml_tpu.analysis import protocol_models
+
+    model_stats: dict = {}
+    protocol_models.run_builtin(stats=model_stats)
     return {
         "pass_seconds": {
             k.replace("-", "_"): round(v, 3) for k, v in timings.items()
         },
         "active_findings": len(active),
+        "model_states": {
+            k.replace("-", "_"): v["states"] for k, v in model_stats.items()
+        },
         "lockdep_smoke_seconds": round(smoke_s, 3),
         "lockdep_smoke_acquisitions": san.acquisitions,
     }

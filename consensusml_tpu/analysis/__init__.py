@@ -1,6 +1,6 @@
 """cml-check: JAX-aware static analysis for the gossip training stack.
 
-Seven passes (CLI: ``tools/cml_check.py --all``; docs:
+Nine passes (CLI: ``tools/cml_check.py --all``; docs:
 ``docs/static_analysis.md``):
 
 - :mod:`~consensusml_tpu.analysis.host_sync` — AST lint for host/device
@@ -32,6 +32,20 @@ Seven passes (CLI: ``tools/cml_check.py --all``; docs:
 - :mod:`~consensusml_tpu.analysis.docs_drift` — metric-schema drift:
   every ``consensusml_*`` family emitted in code must appear in
   ``docs/observability.md``, and doc entries no code emits are stale.
+- :mod:`~consensusml_tpu.analysis.protocol_models` — bounded
+  explicit-state model checking (engine in
+  :mod:`~consensusml_tpu.analysis.model`) of the serving control-plane
+  protocols: BlockPool/PrefixIndex refcounts, the request lifecycle
+  composed with hot-swap generation flips, and membership epoch
+  pin/advance — exhaustively over every interleaving of the abstract
+  actors, with BFS-minimal counterexample traces and recorded-trace
+  conformance (:mod:`~consensusml_tpu.analysis.conformance`) tying the
+  abstractions back to the real classes.
+- :mod:`~consensusml_tpu.analysis.lifecycle` — resource-lifecycle
+  escape lint: every pool block acquisition, slot occupation and
+  OS-handle open must dominate its release on all paths including
+  exception edges; ownership transfer out of the function is the
+  exemption.
 
 This ``__init__`` stays import-light (annotations + findings only, no
 jax): runtime modules import :func:`guarded_by` from here at module

@@ -1,0 +1,308 @@
+"""Recording shims: prove the abstract models faithful to the code.
+
+A model checker over a hand-written abstraction proves nothing about
+the implementation unless the abstraction is tied back to it. The tie
+here is *trace conformance*: thin recording subclasses wrap the real
+classes, tests drive the REAL workloads through them (the PR 17
+randomized pool churn, the lockdep preempt/hot-swap engine e2e), and
+the recorded action sequences must replay as valid paths of the
+abstract models via :func:`consensusml_tpu.analysis.model.replay` —
+the same ``apply``/``invariant`` code the exhaustive search runs.
+
+Replay is strictly harder than "the run didn't crash": every recorded
+action must be *enabled* in the model at that point (a recorded
+``extend`` must pop the block ids the model's LIFO free stack predicts,
+a recorded ``admission`` must carry the continuation flag the model's
+preempt/readmit accounting implies), and every intermediate state must
+satisfy the invariants. A drift between model and code — a reordered
+free list, a lost readmission — fails replay with the exact step.
+
+Shims:
+
+- :class:`RecordingPool` — :class:`~consensusml_tpu.serve.pool.blocks.
+  BlockPool` subclass recording begin/extend/adopt/pin/unpin/shrink/
+  release with concrete block ids (``alloc`` records via its begin +
+  extend legs). :func:`pool_model_for` builds the matching
+  :class:`~.protocol_models.PoolModel` at the pool's REAL geometry —
+  replay needs no bounded state space, so real sizes are fine.
+- :func:`request_trace_labels` — adapts the engine's own
+  :class:`~consensusml_tpu.obs.requests.RequestTraceRegistry` event
+  stream (submit / admission.defer / admission / prefill / decode /
+  preempt / hotswap / complete) into request-model labels, merged
+  across requests in timestamp order. No engine changes needed: the
+  wide-event instrumentation IS the recording.
+- :class:`RecordingMembership` — pin/advance/release over
+  :class:`~consensusml_tpu.swarm.membership.MembershipController`,
+  with pins mapped onto model round actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Tuple
+
+from .protocol_models import MembershipModel, PoolModel, RequestModel
+
+__all__ = [
+    "RecordingPool",
+    "pool_model_for",
+    "replay_pool_trace",
+    "request_trace_labels",
+    "request_model_for",
+    "replay_request_registry",
+    "RecordingMembership",
+    "membership_model_for",
+    "replay_membership_trace",
+]
+
+
+# ---------------------------------------------------------------------------
+# BlockPool
+# ---------------------------------------------------------------------------
+
+
+def _make_recording_pool():
+    """Build the RecordingPool class lazily: ``serve.pool`` imports
+    numpy-adjacent machinery the analysis package must not pull at
+    import time (cml-check runs in bare CI environments)."""
+    from consensusml_tpu.serve.pool.blocks import BlockPool
+
+    class RecordingPool(BlockPool):
+        """BlockPool that appends one model label per mutation.
+
+        ``alloc`` is begin + extend in the real pool and dispatches
+        through the overridden legs, so the recording decomposes it the
+        same way the model does."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.trace: List[Tuple[Any, ...]] = []
+
+        def begin(self, slot: int) -> None:
+            super().begin(slot)
+            self.trace.append(("begin", slot))
+
+        def extend(self, slot: int, n_blocks: int = 1):
+            got = super().extend(slot, n_blocks)
+            self.trace.append(("extend", slot, tuple(got)))
+            return got
+
+        def adopt(self, slot: int, blocks):
+            got = super().adopt(slot, blocks)
+            self.trace.append(("adopt", slot, tuple(got)))
+            return got
+
+        def pin(self, block: int) -> None:
+            super().pin(block)
+            self.trace.append(("pin", int(block)))
+
+        def unpin(self, block: int) -> None:
+            super().unpin(block)
+            self.trace.append(("unpin", int(block)))
+
+        def shrink(self, slot: int, keep_blocks: int):
+            dropped = super().shrink(slot, keep_blocks)
+            self.trace.append(("shrink", slot, int(keep_blocks)))
+            return dropped
+
+        def release(self, slot: int):
+            owned = super().release(slot)
+            self.trace.append(("release", slot))
+            return owned
+
+    return RecordingPool
+
+
+class _LazyRecordingPool:
+    """Constructor proxy: ``RecordingPool(...)`` builds the subclass on
+    first use without importing serve.pool at module import."""
+
+    _cls = None
+
+    def __call__(self, *a, **kw):
+        if _LazyRecordingPool._cls is None:
+            _LazyRecordingPool._cls = _make_recording_pool()
+        return _LazyRecordingPool._cls(*a, **kw)
+
+
+RecordingPool = _LazyRecordingPool()
+
+
+def pool_model_for(pool) -> PoolModel:
+    """The abstract pool at the REAL pool's geometry (replay only —
+    too many blocks for exhaustive search, which is fine: replay
+    walks one path)."""
+    return PoolModel(
+        num_slots=pool.num_slots,
+        usable_blocks=pool.num_blocks - 1,
+        blocks_per_slot=pool.blocks_per_slot,
+    )
+
+
+def replay_pool_trace(pool) -> Any:
+    """Replay a RecordingPool's trace through the abstract model;
+    raises ``ConformanceError`` on the first divergent step. Returns
+    the model's final state so tests can cross-check it against the
+    real pool's."""
+    from .model import replay
+
+    return replay(pool_model_for(pool), pool.trace)
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle (adapter over the engine's own trace registry)
+# ---------------------------------------------------------------------------
+
+_TERMINAL_OK = ("complete", "eos", "max_tokens", "length", "stop")
+
+
+def request_trace_labels(
+    traces: Iterable, n_slots: int
+) -> Tuple[List[Tuple[Any, ...]], int]:
+    """Convert RequestTrace event streams into one merged label
+    sequence. Returns ``(labels, n_requests)``.
+
+    Ordering: global sort by event timestamp, stable so each request's
+    own event order is preserved; at equal timestamps, events that
+    FREE resources (complete / preempt) sort before events that claim
+    them, which resolves the only ambiguity a microsecond clock tie
+    can introduce (slot hand-off).
+    """
+    traces = list(traces)
+    rows: List[Tuple[float, int, int, Tuple[Any, ...]]] = []
+    for i, tr in enumerate(traces):
+        for k, ev in enumerate(tr.events):
+            name = ev.get("name")
+            ts = float(ev.get("ts_us", 0.0))
+            if name == "submit":
+                lab: Optional[Tuple[Any, ...]] = ("submit", i)
+            elif name == "admission.defer":
+                lab = ("defer", i)
+            elif name == "admission":
+                lab = (
+                    "admit", i, int(ev["slot"]),
+                    bool(ev.get("continuation", False)),
+                )
+            elif name == "prefill":
+                lab = ("prefill", i)
+            elif name == "decode":
+                lab = ("tick", i)
+            elif name == "preempt":
+                lab = ("preempt", i)
+            elif name == "hotswap":
+                lab = ("observe_swap", i, int(ev.get("generation", 0)))
+            elif name == "complete":
+                reason = tr.finish_reason or "complete"
+                lab = (
+                    ("complete", i) if reason in _TERMINAL_OK
+                    else ("cancel", i)
+                )
+            else:
+                lab = None  # spec/accounting events carry no transition
+            if lab is not None:
+                frees = lab[0] in ("complete", "cancel", "preempt")
+                rows.append((ts, 0 if frees else 1, k, lab))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return [r[3] for r in rows], len(traces)
+
+
+def request_model_for(n_requests: int, n_slots: int) -> RequestModel:
+    """Replay-mode request model: per-request targets and bounds are
+    unknown in a recording, so guards relax to the structural protocol
+    (``strict=False``) while generation monotonicity, slot aliasing,
+    continuation accounting and lost-stream ghosts stay enforced."""
+    return RequestModel(
+        n_requests=n_requests, n_slots=n_slots, strict=False
+    )
+
+
+def replay_request_registry(registry, n_slots: int) -> Any:
+    """Replay every completed request in an engine's trace registry
+    through the abstract lifecycle model. Returns the final model
+    state."""
+    from .model import replay
+
+    traces = [
+        t for t in registry.completed()
+        if t.finish_reason not in ("superseded", "truncated", "rejected")
+    ]
+    labels, n = request_trace_labels(traces, n_slots)
+    return replay(request_model_for(n, n_slots), labels)
+
+
+# ---------------------------------------------------------------------------
+# membership epochs
+# ---------------------------------------------------------------------------
+
+
+def _make_recording_membership():
+    from consensusml_tpu.swarm.membership import MembershipController
+
+    class RecordingMembership(MembershipController):
+        """MembershipController recording pin/advance/release as model
+        labels. Pins map onto model round actors (lowest free index);
+        ``advance`` records its internal gauge feed too — the real
+        controller feeds metrics inside ``advance`` under the
+        ``_fed_epoch`` claim, which is exactly the model's
+        advance-then-feed pair."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.trace: List[Tuple[Any, ...]] = []
+            self._actors: dict[int, int] = {}  # round actor -> pinned epoch
+            self.max_rounds = 0
+            self.n_advances = 0
+
+        def pin(self):
+            view = super().pin()
+            a = 0
+            while a in self._actors:
+                a += 1
+            self._actors[a] = view.epoch
+            self.max_rounds = max(self.max_rounds, a + 1)
+            self.trace.append(("pin", a))
+            return view
+
+        def release(self, view) -> None:
+            super().release(view)
+            # two pins of one epoch are interchangeable in the model;
+            # any holder of view.epoch is a consistent attribution
+            for a, e in self._actors.items():
+                if e == view.epoch:
+                    del self._actors[a]
+                    self.trace.append(("complete", a))
+                    break
+
+        def advance(self):
+            view = super().advance()
+            self.n_advances += 1
+            self.trace.append(("advance", 0))
+            self.trace.append(("feed", 0))
+            return view
+
+    return RecordingMembership
+
+
+class _LazyRecordingMembership:
+    _cls = None
+
+    def __call__(self, *a, **kw):
+        if _LazyRecordingMembership._cls is None:
+            _LazyRecordingMembership._cls = _make_recording_membership()
+        return _LazyRecordingMembership._cls(*a, **kw)
+
+
+RecordingMembership = _LazyRecordingMembership()
+
+
+def membership_model_for(mc) -> MembershipModel:
+    return MembershipModel(
+        n_rounds=max(1, mc.max_rounds),
+        n_advancers=1,
+        max_epoch=max(1, mc.n_advances),
+    )
+
+
+def replay_membership_trace(mc) -> Any:
+    from .model import replay
+
+    return replay(membership_model_for(mc), mc.trace)

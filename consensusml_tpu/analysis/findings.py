@@ -52,6 +52,9 @@ class Finding:
     detail: str  # rule-specific token
     message: str  # human sentence
     line: int = 0  # 1-based; 0 when not tied to a source line
+    # model-pass findings carry the minimal action trace that reaches the
+    # violation, so CI logs hold the repro without rerunning the checker
+    counterexample: tuple[str, ...] = ()
 
     @property
     def id(self) -> str:
@@ -63,6 +66,10 @@ class Finding:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["id"] = self.id
+        if not self.counterexample:
+            d.pop("counterexample", None)
+        else:
+            d["counterexample"] = list(self.counterexample)
         return d
 
     def render(self) -> str:

@@ -1,0 +1,454 @@
+"""Resource-lifecycle escape lint (cml-check pass 9).
+
+The static complement to ``BlockPool.check()``: ``check()`` proves the
+partition invariant on the states a run happened to visit; this pass
+proves, per call site, that every resource ACQUISITION is covered by a
+matching release on all forward paths *including exception edges* — the
+path class runtime checks see last (an exception between acquire and
+release leaks silently until pool pressure turns it into mysterious
+``NoFreeBlocks`` deferrals).
+
+Three resource families:
+
+- **pool** — ``alloc``/``extend``/``begin``/``adopt``/``pin`` on any
+  receiver whose name ends in a pool (``self._pool``, ``pool``), paired
+  with ``release``/``shrink`` (``unpin`` for ``pin``).
+- **slot** — ``occupy`` on slot tables, paired with ``release``/``free``.
+- **handle** — ``open(...)`` / ``socket.socket(...)`` bound to a local,
+  paired with ``.close()`` (a ``with`` block or ownership transfer —
+  returning the handle, storing it on ``self``, passing it on — also
+  discharges the obligation).
+
+An acquisition is COVERED when one of these holds on the forward
+continuation (the statements after it, walking out through enclosing
+blocks to the function end; loop back-edges ignored):
+
+1. it is lexically inside a ``with`` whose context manager is the
+   resource itself;
+2. it is inside a ``try`` whose handler or ``finally`` performs a
+   matching release on the same receiver (the engine's
+   ``except BaseException: pool.release(idx); raise`` admission guard);
+3. the continuation reaches a matching release — or a protecting
+   ``try`` as in (2) — before any statement that can raise (any call,
+   ``raise``, ``assert``); plain data moves between acquire and release
+   are fine;
+4. the continuation reaches the function end with no risky statement
+   at all (the acquire escapes as owned state — e.g. ``extend`` as the
+   last action of a grow step, ownership parked in the pool's own
+   accounting).
+
+Like every detector pass (PR 15 pattern), the pass first lints a
+seeded leak-on-exception fixture and declares ITSELF broken if the
+fixture does not produce a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "run_pass", "PASS"]
+
+PASS = "lifecycle"
+
+# acquire method -> releases that discharge it, per receiver family
+_POOL_ACQ = {
+    "alloc": ("release", "shrink"),
+    "begin": ("release", "shrink"),
+    "extend": ("release", "shrink"),
+    "adopt": ("release", "shrink"),
+    "pin": ("unpin",),
+}
+_SLOT_ACQ = {"occupy": ("release", "free")}
+_POOL_SUFFIXES = ("pool",)
+_SLOT_SUFFIXES = ("table", "slots")
+_HANDLE_CALLS = {
+    "open",
+    "socket.socket",
+    "socket.create_connection",
+    "socket.create_server",
+}
+
+
+def _expr_text(node) -> str | None:
+    """Dotted text of a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _recv_family(recv: str) -> str | None:
+    last = recv.rsplit(".", 1)[-1].lower()
+    if any(last.endswith(s) or s in last for s in _POOL_SUFFIXES):
+        return "pool"
+    if any(last.endswith(s) for s in _SLOT_SUFFIXES):
+        return "slot"
+    return None
+
+
+def _acquires_in(node):
+    """Yield ``(call, family, method, receiver_text, releases)`` for
+    every pool/slot acquire call in ``node``'s expression subtree."""
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        recv = _expr_text(fn.value)
+        if recv is None:
+            continue
+        fam = _recv_family(recv)
+        if fam == "pool" and fn.attr in _POOL_ACQ:
+            yield call, fam, fn.attr, recv, _POOL_ACQ[fn.attr]
+        elif fam == "slot" and fn.attr in _SLOT_ACQ:
+            yield call, fam, fn.attr, recv, _SLOT_ACQ[fn.attr]
+
+
+def _is_release(node, recv: str, releases) -> bool:
+    """Does ``node``'s subtree contain ``<recv>.<release>(...)``? A
+    conditional release in the continuation counts — the lint flags
+    exception-edge leaks, not control-flow conservatism."""
+    for call in ast.walk(node):
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in releases
+            and _expr_text(call.func.value) == recv
+        ):
+            return True
+    return False
+
+
+def _protective_try(st, recv: str, releases) -> bool:
+    """A ``try`` whose handlers or ``finally`` release the resource."""
+    if not isinstance(st, ast.Try):
+        return False
+    for h in st.handlers:
+        for s in h.body:
+            if _is_release(s, recv, releases):
+                return True
+    for s in st.finalbody:
+        if _is_release(s, recv, releases):
+            return True
+    return False
+
+
+def _risky(st) -> bool:
+    """Can this statement raise between acquire and release? Any call,
+    explicit raise, or assert. Plain data moves (constant/name binds,
+    ``pass``, bare ``return``) cannot."""
+    for n in ast.walk(st):
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert)):
+            return True
+    return False
+
+
+class _FuncScan:
+    """Scan one function: every acquire must be covered per the module
+    docstring's rules 1–4."""
+
+    def __init__(self, path: str, symbol: str, findings: list):
+        self.path = path
+        self.symbol = symbol
+        self.findings = findings
+
+    # continuation = list of statement-lists: the statements after the
+    # current one in its block, then after the enclosing statement in
+    # ITS block, ... out to the function body's tail.
+    def scan(self, fn) -> None:
+        self._block(fn.body, conts=[], prots=[])
+
+    def _block(self, stmts, conts, prots) -> None:
+        for i, st in enumerate(stmts):
+            rest = [stmts[i + 1 :]] + conts
+            self._check_stmt(st, rest, prots)
+            self._recurse(st, rest, prots)
+
+    def _check_stmt(self, st, conts, prots) -> None:
+        # only this statement's own expressions; child blocks are
+        # visited by _recurse with their own continuations
+        headers = self._header_nodes(st)
+        for h in headers:
+            for call, fam, meth, recv, rels in _acquires_in(h):
+                if self._covered(st, recv, rels, conts, prots):
+                    continue
+                self.findings.append(
+                    Finding(
+                        PASS,
+                        "leak-on-exception",
+                        self.path,
+                        self.symbol,
+                        f"{fam}.{meth}",
+                        f"`{recv}.{meth}(...)` has no matching "
+                        f"{'/'.join(rels)} on the exception path: an "
+                        "error raised before the release leaks the "
+                        f"{fam} resource (wrap in try/finally or "
+                        "release in an except handler and re-raise)",
+                        call.lineno,
+                    )
+                )
+        self._check_handles(st, conts, prots)
+
+    def _header_nodes(self, st):
+        """The statement's own expression nodes, excluding child
+        statement blocks (which recurse with their own context)."""
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return []
+        if isinstance(st, (ast.If, ast.While)):
+            return [st.test]
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return [st.iter, st.target]
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return [item.context_expr for item in st.items]
+        if isinstance(st, ast.Try):
+            return []
+        return [st]
+
+    def _covered(self, st, recv, rels, conts, prots) -> bool:
+        # rule 1/2: an enclosing protector already covers this receiver
+        for prot_recv, prot_rels in prots:
+            if prot_recv == recv and set(rels) & set(prot_rels):
+                return True
+        # the acquiring statement may release inline (rare but legal:
+        # `pool.release(pool.begin(s))`-style wrappers)
+        # rules 3/4: scan the forward continuation
+        for block in conts:
+            for nxt in block:
+                if _is_release(nxt, recv, rels):
+                    return True
+                if _protective_try(nxt, recv, rels):
+                    return True
+                if _risky(nxt):
+                    return False
+        return True  # clean run-off: ownership parked, nothing can raise
+
+    # -- handle family ------------------------------------------------------
+
+    def _check_handles(self, st, conts, prots) -> None:
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            return
+        tgt = st.targets[0]
+        if not isinstance(tgt, ast.Name):
+            return  # self.x = open(...) is ownership transfer by itself
+        call = st.value
+        if not isinstance(call, ast.Call):
+            return
+        cname = (
+            call.func.id if isinstance(call.func, ast.Name)
+            else _expr_text(call.func)
+        )
+        if cname not in _HANDLE_CALLS:
+            return
+        recv = tgt.id
+        rels = ("close",)
+        if self._escapes(recv, conts):
+            return
+        if self._covered(st, recv, rels, conts, prots):
+            return
+        self.findings.append(
+            Finding(
+                PASS,
+                "handle-leak",
+                self.path,
+                self.symbol,
+                f"handle.{cname}",
+                f"`{recv} = {cname}(...)` is never closed on the "
+                "exception path and does not escape this function: "
+                "use `with`, or close in a finally",
+                st.lineno,
+            )
+        )
+
+    def _escapes(self, name: str, conts) -> bool:
+        """Ownership transfer: the handle is returned/yielded, stored
+        on an object, or passed to another call — someone else closes."""
+        for block in conts:
+            for nxt in block:
+                for n in ast.walk(nxt):
+                    if isinstance(n, (ast.Return, ast.Yield)) and n.value:
+                        if any(
+                            isinstance(x, ast.Name) and x.id == name
+                            for x in ast.walk(n.value)
+                        ):
+                            return True
+                    if isinstance(n, ast.Assign) and isinstance(
+                        n.value, ast.Name
+                    ) and n.value.id == name:
+                        if any(
+                            not isinstance(t, ast.Name) for t in n.targets
+                        ):
+                            return True
+                    if isinstance(n, ast.Call):
+                        fn_recv = (
+                            _expr_text(n.func.value)
+                            if isinstance(n.func, ast.Attribute) else None
+                        )
+                        for arg in list(n.args) + [
+                            kw.value for kw in n.keywords
+                        ]:
+                            if any(
+                                isinstance(x, ast.Name) and x.id == name
+                                for x in ast.walk(arg)
+                            ) and fn_recv != name:
+                                return True
+        return False
+
+    def _recurse(self, st, conts, prots) -> None:
+        if isinstance(st, ast.Try):
+            inner = list(prots)
+            for _call, _fam, _m, recv, rels in self._try_protects(st):
+                inner.append((recv, rels))
+            self._block(st.body, conts, inner)
+            for h in st.handlers:
+                self._block(h.body, conts, prots)
+            self._block(st.orelse, conts, prots)
+            self._block(st.finalbody, conts, prots)
+        elif isinstance(st, (ast.If,)):
+            self._block(st.body, conts, prots)
+            self._block(st.orelse, conts, prots)
+        elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            # back-edges ignored: per-iteration acquires must be covered
+            # within the iteration or by the post-loop continuation
+            self._block(st.body, conts, prots)
+            self._block(st.orelse, conts, prots)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = list(prots)
+            for item in st.items:
+                ce = item.context_expr
+                recv = None
+                if isinstance(ce, ast.Call) and isinstance(
+                    ce.func, ast.Attribute
+                ):
+                    recv = _expr_text(ce.func.value)
+                elif isinstance(ce, (ast.Name, ast.Attribute)):
+                    recv = _expr_text(ce)
+                if recv:
+                    # `with pool.guard(s):`-style scopes release on exit
+                    inner.append(
+                        (recv, ("release", "shrink", "unpin", "close", "free"))
+                    )
+                if item.optional_vars is not None and isinstance(
+                    item.optional_vars, ast.Name
+                ):
+                    inner.append(
+                        (item.optional_vars.id, ("close",))
+                    )
+            self._block(st.body, conts, inner)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: fresh scope, its own continuations
+            sub = _FuncScan(
+                self.path,
+                f"{self.symbol}.<locals>.{st.name}" if self.symbol else st.name,
+                self.findings,
+            )
+            sub.scan(st)
+
+    def _try_protects(self, st):
+        """Receivers this ``try`` releases in a handler or finally."""
+        found = []
+        rel_names = set()
+        for rels in list(_POOL_ACQ.values()) + list(_SLOT_ACQ.values()):
+            rel_names.update(rels)
+        rel_names.add("close")
+        bodies = [s for h in st.handlers for s in h.body] + list(st.finalbody)
+        for s in bodies:
+            for call in ast.walk(s):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in rel_names
+                ):
+                    recv = _expr_text(call.func.value)
+                    if recv:
+                        found.append((None, None, None, recv, (call.func.attr,)))
+        return found
+
+
+def lint_source(src: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                PASS, "syntax-error", path, "", "parse",
+                f"file does not parse: {e}", e.lineno or 0,
+            )
+        ]
+    findings: list[Finding] = []
+
+    def walk_scope(node, scope: str) -> None:
+        for item in ast.iter_child_nodes(node):
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sym = f"{scope}.{item.name}" if scope else item.name
+                _FuncScan(path, sym, findings).scan(item)
+            elif isinstance(item, ast.ClassDef):
+                walk_scope(item, f"{scope}.{item.name}" if scope else item.name)
+
+    walk_scope(tree, "")
+    return findings
+
+
+def lint_file(path: str, repo_root: str) -> list[Finding]:
+    rel = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    findings: list[Finding] = list(_self_test())
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p, repo_root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn), repo_root)
+                    )
+    return findings
+
+
+# -- negative fixture (detector self-test, PR 15 pattern) -------------------
+
+_LEAK_FIXTURE = '''
+def admit(self, idx, n):
+    blocks = self._pool.alloc(idx, n)      # acquire
+    tokens = self.run_prefill(idx, blocks)  # can raise: leak on the way out
+    self._pool.release(idx)
+    return tokens
+'''
+
+
+def _self_test() -> list[Finding]:
+    """Lint the seeded leak-on-exception fixture; no finding means the
+    detector is broken and the PASS fails loudly rather than silently
+    approving everything."""
+    got = lint_source(_LEAK_FIXTURE, "<lifecycle-fixture>")
+    if any(
+        f.rule == "leak-on-exception" and f.detail == "pool.alloc"
+        for f in got
+    ):
+        return []
+    return [
+        Finding(
+            PASS,
+            "detector-broken",
+            "<lifecycle-fixture>",
+            "admit",
+            "no-finding",
+            "seeded leak-on-exception fixture produced no finding — "
+            "the lifecycle lint is not detecting leaks",
+        )
+    ]

@@ -47,6 +47,16 @@ Rules:
   thread/callback), so holding the lock at definition time proves
   nothing about call time. Baseline the finding if the closure provably
   never escapes.
+- ``unlocked-read`` — a read of a guarded attribute from OUTSIDE the
+  owning class: ``watcher._generation`` in engine code reaches into
+  ``GenerationWatcher``'s ``@guarded_by`` state with no lock at all.
+  Receivers are typed from construction sites (``self._w =
+  GenerationWatcher(...)`` / ``w = GenerationWatcher(...)``), so a
+  same-named private attr on an unrelated class never false-positives.
+  The package-wide guarded-class map is built in :func:`lint_paths`'s
+  first phase; intentional cross-class reads (tests' white-box pokes
+  live outside the scanned roots; in-package ones are reviewed) get
+  baselined, real ones get a lock or an accessor.
 
 The decorator itself lives in
 :mod:`consensusml_tpu.analysis.annotations` and is a pure metadata
@@ -457,7 +467,163 @@ def _scan_bare_acquire(
     return findings
 
 
-def lint_source(src: str, path: str) -> list[Finding]:
+# -- cross-class unlocked reads ---------------------------------------------
+
+
+def _guarded_classes_in_tree(tree: ast.AST) -> dict[str, dict[str, str]]:
+    """class name -> (guarded attr -> lock) for every annotated class."""
+    out: dict[str, dict[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            gm = _guard_map_from_class(node)
+            if gm:
+                out[node.name] = gm
+    return out
+
+
+def _ctor_class(value: ast.AST, guarded: dict[str, dict[str, str]]):
+    """The guarded class a ``X(...)`` construction instantiates, else
+    None. Both ``GenerationWatcher(...)`` and ``mod.GenerationWatcher
+    (...)`` resolve on the final name segment."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    return name if name in guarded else None
+
+
+class _ExternalReadScan:
+    """Flag loads of another class's guarded attributes.
+
+    Receiver typing is construction-site based, the same idiom the
+    lock-order pass uses: ``self._w = GenerationWatcher(...)`` types
+    ``self._w`` for the whole enclosing class; ``w = Watcher(...)``
+    types local ``w`` for the enclosing function. An untypeable
+    receiver is never flagged — this rule must not guess.
+    """
+
+    def __init__(
+        self, path: str, guarded: dict[str, dict[str, str]],
+        findings: list[Finding],
+    ):
+        self.path = path
+        self.guarded = guarded
+        self.findings = findings
+
+    def scan_tree(self, tree: ast.AST) -> None:
+        self._scan_scope(tree, cls_name=None, attr_types={}, qual="")
+
+    def _scan_scope(self, node, cls_name, attr_types, qual) -> None:
+        for item in ast.iter_child_nodes(node):
+            if isinstance(item, ast.ClassDef):
+                # type self.<x> from every construction site in the class
+                types: dict[str, str] = {}
+                for n in ast.walk(item):
+                    if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                        targets = (
+                            n.targets if isinstance(n, ast.Assign)
+                            else [n.target]
+                        )
+                        cls = _ctor_class(n.value, self.guarded)
+                        if cls is None:
+                            continue
+                        for t in targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                types[attr] = cls
+                self._scan_scope(
+                    item, item.name, types,
+                    f"{qual}.{item.name}" if qual else item.name,
+                )
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(
+                    item, cls_name, attr_types,
+                    f"{qual}.{item.name}" if qual else item.name,
+                )
+            else:
+                self._scan_scope(item, cls_name, attr_types, qual)
+
+    def _scan_fn(self, fn, cls_name, attr_types, qual) -> None:
+        local_types: dict[str, str] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and (
+                isinstance(n.targets[0], ast.Name)
+            ):
+                cls = _ctor_class(n.value, self.guarded)
+                if cls is not None:
+                    local_types[n.targets[0].id] = cls
+
+        def recv_type(node) -> str | None:
+            if isinstance(node, ast.Name):
+                return local_types.get(node.id)
+            attr = _self_attr(node)
+            if attr is not None:
+                return attr_types.get(attr)
+            return None
+
+        def held_locks(n, held):
+            # `with other._lock:` legitimises reads of other's state
+            out = set(held)
+            for item in n.items:
+                if isinstance(item.context_expr, ast.Attribute):
+                    out.add(ast.dump(item.context_expr))
+            return out
+
+        def walk(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    nh = held_locks(child, held)
+                    for item in child.items:
+                        walk(item.context_expr, held)
+                    for st in child.body:
+                        walk(st, nh)
+                    continue
+                if isinstance(child, ast.Attribute) and isinstance(
+                    child.ctx, ast.Load
+                ):
+                    self._check_attr(child, recv_type, cls_name, qual, held)
+                walk(child, held)
+
+        walk(fn, frozenset())
+
+    def _check_attr(self, node, recv_type, cls_name, qual, held) -> None:
+        owner = recv_type(node.value)
+        if owner is None or owner == cls_name:
+            return  # untypeable, or the class's own state (self-rules)
+        lock = self.guarded.get(owner, {}).get(node.attr)
+        if lock is None:
+            return
+        recv = ast.dump(
+            ast.Attribute(value=node.value, attr=lock, ctx=ast.Load())
+        )
+        if recv in held:
+            return  # read under `with <recv>.<lock>:`
+        recv_txt = (
+            node.value.id if isinstance(node.value, ast.Name)
+            else f"self.{_self_attr(node.value)}"
+        )
+        self.findings.append(
+            Finding(
+                PASS, "unlocked-read", self.path, qual, node.attr,
+                f"read of {recv_txt}.{node.attr} from outside {owner} "
+                f"(declared guarded_by({lock!r})) with no lock held — "
+                "use the owning class's locked accessor or take "
+                f"{recv_txt}.{lock}",
+                node.lineno,
+            )
+        )
+
+
+def lint_source(
+    src: str,
+    path: str,
+    guarded_classes: dict[str, dict[str, str]] | None = None,
+) -> list[Finding]:
+    """Per-file rules; when ``guarded_classes`` (the package-wide map
+    from :func:`lint_paths`'s first phase) is given, the cross-class
+    unlocked-read rule runs too."""
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -485,20 +651,15 @@ def lint_source(src: str, path: str) -> list[Finding]:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scan.scan(item)
         findings.extend(scan.findings)
+    if guarded_classes:
+        _ExternalReadScan(path, guarded_classes, findings).scan_tree(tree)
     return findings
 
 
-def lint_file(path: str, repo_root: str) -> list[Finding]:
-    rel = os.path.relpath(path, repo_root)
-    with open(path, encoding="utf-8") as f:
-        return lint_source(f.read(), rel)
-
-
-def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
-    findings: list[Finding] = []
+def _iter_py(paths: list[str]):
     for p in paths:
         if os.path.isfile(p):
-            findings.extend(lint_file(p, repo_root))
+            yield p
             continue
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = [
@@ -506,7 +667,36 @@ def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
             ]
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
-                    findings.extend(
-                        lint_file(os.path.join(dirpath, fn), repo_root)
-                    )
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_file(
+    path: str,
+    repo_root: str,
+    guarded_classes: dict[str, dict[str, str]] | None = None,
+) -> list[Finding]:
+    rel = os.path.relpath(path, repo_root)
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), rel, guarded_classes)
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    # phase 1: the package-wide guarded-class map (cheap: one parse per
+    # file, reused nowhere else — the rule must see classes defined in
+    # files OUTSIDE the restricted roots too, so a `--paths serve/` run
+    # still types `GenerationWatcher` correctly)
+    guarded: dict[str, dict[str, str]] = {}
+    files = list(_iter_py(paths))
+    for fpath in files:
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=fpath)
+        except SyntaxError:
+            continue  # reported by phase 2
+        for name, gm in _guarded_classes_in_tree(tree).items():
+            guarded.setdefault(name, {}).update(gm)
+    # phase 2: per-file rules + cross-class reads
+    findings: list[Finding] = []
+    for fpath in files:
+        findings.extend(lint_file(fpath, repo_root, guarded))
     return findings

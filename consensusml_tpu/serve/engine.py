@@ -26,6 +26,16 @@ Paged mode adds three behaviors on top of the PR 5 loop
   flips params (and every resident slot's generation tag) between two
   decode steps with zero dropped streams and zero recompiles.
 
+The admission / preempt-readmit / hot-swap protocol this loop
+implements is model-checked over every interleaving by the
+``request-lifecycle`` abstraction (cml-check pass 8,
+:mod:`consensusml_tpu.analysis.protocol_models`): slots never aliased,
+per-stream generations monotone, no stream lost across a flip, a
+preempted stream re-admitted exactly once as a continuation. The
+engine's own wide-event request traces double as the conformance
+recording — a real preempt + hot-swap run must replay as a valid model
+path (``tests/test_model_check.py``).
+
 Sampling is in-jit and per-request (:mod:`consensusml_tpu.serve.
 sampling`): ``submit(temperature=, top_p=, seed=, eos_id=)`` threads the
 triple through the compiled steps as data — greedy is the

@@ -23,6 +23,15 @@ of fixed-shape physical blocks:
   (free ∪ Σ-owned always partitions the physical blocks exactly,
   counting multiplicity now that blocks are shareable).
 
+These invariants are also proven over EVERY interleaving of admissions,
+adoptions, pins and releases — not just the schedules the tests run —
+by the ``pool-refcount`` abstract model in
+:mod:`consensusml_tpu.analysis.protocol_models` (cml-check pass 8),
+with recorded-trace conformance tying the model to this class
+block-id-exactly (:mod:`consensusml_tpu.analysis.conformance`). Change
+the ownership protocol here and the model must change with it, or
+replay fails in ``tests/test_model_check.py``.
+
 **Refcounted sharing (prefix cache).** A physical block may appear in
 MORE than one slot's owned list: the prefix cache (``prefix.py``) maps a
 matched block-aligned prompt prefix straight into a new slot's table via

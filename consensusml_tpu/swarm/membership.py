@@ -19,6 +19,15 @@ the sequence of views:
   complete against the old view while the next round picks up the new
   one; no barrier, no drain. ``advance`` never blocks on pins.
 
+The pin/advance protocol (plus the ``_fed_epoch`` gauge-feed claim
+below) is model-checked over every pin/advance/complete interleaving by
+the ``membership-epoch`` abstraction in
+:mod:`consensusml_tpu.analysis.protocol_models` (cml-check pass 8):
+rounds complete against their pinned epoch across any number of
+advances, and no gauge feed lands at an older epoch than the newest
+claimed. A recorded pin/advance/release trace of this controller
+replays as a model path (:mod:`consensusml_tpu.analysis.conformance`).
+
 Statuses: ``active`` members gossip and train; ``dead`` members
 (dropped/preempted) are frozen — their replica is untouched until a
 rejoin; ``straggling`` members keep training locally but miss gossip

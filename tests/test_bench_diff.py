@@ -60,6 +60,14 @@ def test_default_spec_is_well_formed():
         assert f"analysis.pass_seconds.{p}" in keys
     assert "analysis.lockdep_smoke_seconds" in keys
     assert "analysis.active_findings" in keys
+    # the protocol-model + lifecycle passes (ISSUE 19): the lifecycle
+    # escape lint rides the 2 s AST budget, the exhaustive model
+    # checker holds a 30 s wall budget of its own
+    assert "analysis.pass_seconds.lifecycle" in keys
+    assert "analysis.pass_seconds.model" in keys
+    model_bounds = {e["bound"] for e in mod.DEFAULT_SPEC
+                    if e["key"] == "analysis.pass_seconds.model"}
+    assert model_bounds == {30.0}
     # the fused kernel tier (ISSUE 16): bit-exactness + HBM-bytes gates
     # on the serving fused_attention block, floor-ratio budgets (down
     # trajectory AND absolute ceiling) per hot-path stage, compile
@@ -128,6 +136,7 @@ def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):
             "pass_seconds": {
                 "host_sync": 0.6, "locks": 0.4, "threads": 9.0,
                 "lockorder": 0.4, "docs_drift": 0.5,
+                "lifecycle": 3.1, "model": 29.0,
             },
             "active_findings": 2,
             "lockdep_smoke_seconds": 45.0,
@@ -140,10 +149,13 @@ def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):
     assert rc == 1
     failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
     assert "analysis.pass_seconds.threads" in failed
+    assert "analysis.pass_seconds.lifecycle" in failed
     assert "analysis.active_findings" in failed
     assert "analysis.lockdep_smoke_seconds" in failed
     ok = {r["key"]: r["status"] for r in doc["rows"]}
     assert ok["analysis.pass_seconds.host_sync"] == "ok"
+    # 29 s of model checking is within its own (30 s) budget
+    assert ok["analysis.pass_seconds.model"] == "ok"
 
 
 def test_min_direction_enforces_floors(tmp_path, capsys):

@@ -989,13 +989,16 @@ def test_cli_all_exits_zero_on_repo():
     assert doc["counts"]["stale"] == 0, doc["stale_baseline"]
     assert set(doc["passes"]) == {
         "host-sync", "locks", "threads", "lockorder", "docs-drift",
-        "schedule", "jaxpr",
+        "lifecycle", "model", "schedule", "jaxpr",
     }
     # per-pass wall time rides the JSON; the AST passes hold their
-    # absolute budget (<2 s each, gated in tools/bench_diff.py's spec)
+    # absolute budget (<2 s each, gated in tools/bench_diff.py's spec;
+    # the exhaustive model checker gets 30 s)
     secs = doc["pass_seconds"]
-    for name in ("host-sync", "locks", "threads", "lockorder", "docs-drift"):
+    for name in ("host-sync", "locks", "threads", "lockorder", "docs-drift",
+                 "lifecycle"):
         assert secs[name] < 2.0, (name, secs)
+    assert secs["model"] < 30.0, secs
 
 
 def test_cli_exits_nonzero_on_threads_bad_fixture(tmp_path):

@@ -1,0 +1,558 @@
+"""Model-checking pass + lifecycle lint suite (ISSUE 19 acceptance).
+
+Three layers, mirroring the pass's own argument for why it can be
+trusted:
+
+1. checker mechanics — state hashing, exact bound semantics (a state
+   reached again at a shallower depth is re-expanded), BFS-minimal
+   counterexamples, replay;
+2. the shipped protocol models — the three correct models hold their
+   invariants over their ENTIRE finite reachable state space, and every
+   seeded-bug fixture model is refuted with a minimal trace (PR 15
+   detector-broken pattern: a fixture the checker cannot refute fails
+   the pass);
+3. conformance — recorded traces from the REAL classes (randomized
+   BlockPool churn, a live preempt + hot-swap engine run, membership
+   pin/advance) replay as valid paths of the abstract models, tying the
+   abstractions back to the code they claim to describe.
+
+The lifecycle escape lint and the locks unlocked-read rule ride along
+with their own seeded fixtures.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from consensusml_tpu.analysis.model import (
+    CheckResult,
+    ConformanceError,
+    IllegalAction,
+    check_model,
+    replay,
+    successors,
+)
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# checker mechanics (toy models)
+# ---------------------------------------------------------------------------
+
+
+class _Graph:
+    """Explicit transition-table model for exercising the checker."""
+
+    name = "toy-graph"
+    subject = "tests/test_model_check.py"
+
+    def __init__(self, edges, bad=()):
+        self.edges = edges  # state -> [(label_head, next_state)]
+        self.bad = frozenset(bad)
+
+    def initial(self):
+        return "0"
+
+    def labels(self, state):
+        return [(head,) for head, _ in self.edges.get(state, [])]
+
+    def apply(self, state, label):
+        for head, nxt in self.edges.get(state, []):
+            if head == label[0]:
+                return nxt
+        raise IllegalAction(f"{label[0]} not enabled in {state}")
+
+    def invariant(self, state):
+        return f"reached bad state {state}" if state in self.bad else None
+
+
+# deep path 0-A-C-T, shallow path 0-B-T, and U behind T: U is only
+# reachable within depth 3 via the SHALLOW path, so finding it proves
+# the checker re-expands T when the 2-step path arrives after the
+# 3-step one (DFS pops toA's branch first given this label order)
+_DIAMOND = {
+    "0": [("toB", "B"), ("toA", "A")],
+    "A": [("ac", "C")],
+    "C": [("ct", "T")],
+    "B": [("bt", "T")],
+    "T": [("tu", "U")],
+}
+
+
+def test_bounded_dfs_reexpands_shallower_revisits():
+    res = check_model(_Graph(_DIAMOND), max_depth=3)
+    assert res.ok and res.states == 6 and res.hit_bound
+    # at depth 2 U is out of reach down every path; T's successor makes
+    # the truncation observable
+    res2 = check_model(_Graph(_DIAMOND), max_depth=2)
+    assert res2.ok and res2.states == 5 and res2.hit_bound
+
+
+def test_unbounded_search_exhausts_and_reports_no_bound():
+    res = check_model(_Graph(_DIAMOND), max_depth=None)
+    assert res.ok and res.states == 6 and not res.hit_bound
+    assert res.max_depth is None
+
+
+def test_counterexample_is_bfs_minimal_with_matching_message():
+    res = check_model(_Graph(_DIAMOND, bad={"U"}), max_depth=4)
+    assert not res.ok
+    # the minimal route is via B (3 steps), even though DFS explores
+    # the 4-step A route
+    assert res.trace == (("toB",), ("bt",), ("tu",))
+    assert res.violation == "reached bad state U"
+    assert "toB ; bt ; tu" == res.format_trace()
+
+
+def test_state_hashing_counts_distinct_states_once():
+    # two routes into T must not double-count it
+    res = check_model(_Graph(_DIAMOND), max_depth=None)
+    assert res.states == len({"0", "A", "B", "C", "T", "U"})
+
+
+def test_successors_filters_illegal_actions():
+    class _Gated(_Graph):
+        def labels(self, state):
+            return [("nope",)] + super().labels(state)
+
+    succ = list(successors(_Gated(_DIAMOND), "0"))
+    assert [(l[0], s) for l, s in succ] == [("toB", "B"), ("toA", "A")]
+
+
+def test_max_states_overflow_raises():
+    class _Unbounded:
+        name = "counter"
+        subject = "x"
+
+        def initial(self):
+            return 0
+
+        def labels(self, state):
+            return [("inc",)]
+
+        def apply(self, state, label):
+            return state + 1
+
+        def invariant(self, state):
+            return None
+
+    with pytest.raises(RuntimeError, match="state space exceeds"):
+        check_model(_Unbounded(), max_depth=None, max_states=50)
+
+
+def test_replay_accepts_valid_path_and_names_failing_step():
+    m = _Graph(_DIAMOND)
+    assert replay(m, [("toB",), ("bt",), ("tu",)]) == "U"
+    with pytest.raises(ConformanceError, match="step 1 ac"):
+        replay(m, [("toB",), ("ac",)])
+    with pytest.raises(ConformanceError, match="step 2 tu"):
+        replay(_Graph(_DIAMOND, bad={"U"}), [("toB",), ("bt",), ("tu",)])
+
+
+def test_violating_initial_state_reported_without_search():
+    res = check_model(_Graph(_DIAMOND, bad={"0"}))
+    assert not res.ok and res.trace == () and "bad state 0" in res.violation
+    assert isinstance(res, CheckResult)
+
+
+# ---------------------------------------------------------------------------
+# the shipped protocol models
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_models_hold_over_their_entire_state_space():
+    from consensusml_tpu.analysis import protocol_models as pm
+
+    for spec in pm.builtin_specs():
+        res = check_model(
+            spec.model, max_depth=spec.max_depth, max_states=spec.max_states
+        )
+        assert res.ok, (spec.model.name, res.violation, res.format_trace())
+        # max_depth=None: full reachability, nothing truncated — the
+        # invariants are proven over the whole space, not a prefix
+        assert not res.hit_bound, spec.model.name
+        assert res.states > 100, (spec.model.name, res.states)
+
+
+def test_every_seeded_bug_fixture_is_refuted_with_minimal_trace():
+    from consensusml_tpu.analysis import protocol_models as pm
+
+    for spec in pm.fixture_specs():
+        res = check_model(spec.model, max_depth=spec.max_depth)
+        assert not res.ok and res.trace, spec.model.name
+        assert len(res.trace) <= spec.max_depth
+        # the trace really is executable and really does end in the
+        # violation: replay the model's own counterexample
+        with pytest.raises(ConformanceError, match="invariant violated"):
+            replay(spec.model, res.trace)
+        # and it is MINIMAL: every proper prefix is violation-free
+        replay(spec.model, res.trace[:-1])
+
+
+def test_run_builtin_clean_and_detector_broken_guard(monkeypatch):
+    from consensusml_tpu.analysis import protocol_models as pm
+
+    assert pm.run_builtin() == []
+
+    # neuter the fixture set: a "fixture" that is actually correct must
+    # surface as detector-broken, never as silently green (PR 15)
+    monkeypatch.setattr(
+        pm, "fixture_specs",
+        lambda: [pm.ModelSpec(
+            pm.PoolModel(), max_depth=4, expect_violation=True,
+        )],
+    )
+    got = pm.run_builtin()
+    assert [f.rule for f in got] == ["detector-broken"]
+    assert got[0].counterexample == ()
+
+
+def test_invariant_violation_finding_carries_counterexample(monkeypatch):
+    import json
+
+    from consensusml_tpu.analysis import protocol_models as pm
+    from consensusml_tpu.analysis import to_json
+
+    # ship a buggy model as if it were a real one: the finding must
+    # carry the minimal action trace, and --json must serialize it
+    monkeypatch.setattr(
+        pm, "builtin_specs",
+        lambda: [pm.ModelSpec(pm.DoubleFreePoolModel(), max_depth=8)],
+    )
+    monkeypatch.setattr(pm, "fixture_specs", lambda: [])
+    got = pm.run_builtin()
+    assert len(got) == 1 and got[0].rule == "invariant-violated"
+    assert got[0].counterexample, got[0]
+    doc = json.loads(to_json(got, [], [], passes_run=["model"]))
+    (f,) = doc["findings"]
+    assert f["counterexample"] == list(got[0].counterexample)
+    # clean findings omit the field entirely
+    from consensusml_tpu.analysis import Finding
+
+    assert "counterexample" not in Finding(
+        "model", "r", "p", "s", "d", "m"
+    ).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle escape lint
+# ---------------------------------------------------------------------------
+
+
+def test_lifecycle_seeded_fixture_fires_and_package_is_clean():
+    from consensusml_tpu.analysis import lifecycle
+
+    got = lifecycle.lint_source(lifecycle._LEAK_FIXTURE, "<fx>")
+    assert [f.rule for f in got] == ["leak-on-exception"]
+    assert got[0].detail == "pool.alloc"
+
+    pkg = lifecycle.lint_paths(
+        [os.path.join(REPO, "consensusml_tpu")], REPO
+    )
+    assert pkg == [], [f.id for f in pkg]
+
+
+def test_lifecycle_self_test_reports_broken_detector(monkeypatch):
+    from consensusml_tpu.analysis import lifecycle
+
+    monkeypatch.setattr(lifecycle, "_LEAK_FIXTURE", "def f():\n    pass\n")
+    got = lifecycle.lint_paths([], REPO)
+    assert [f.rule for f in got] == ["detector-broken"]
+
+
+def test_lifecycle_try_finally_and_handler_release_cover():
+    from consensusml_tpu.analysis import lifecycle
+
+    clean = """
+def a(self, s):
+    self._pool.begin(s)
+    try:
+        self.run(s)
+    finally:
+        self._pool.release(s)
+
+def b(self, s):
+    self._pool.begin(s)
+    try:
+        self.run(s)
+    except Exception:
+        self._pool.release(s)
+        raise
+"""
+    assert lifecycle.lint_source(clean, "<fx>") == []
+
+
+def test_lifecycle_handle_rules_flag_leak_and_exempt_transfer():
+    from consensusml_tpu.analysis import lifecycle
+
+    leak = """
+def f(p):
+    fh = open(p)
+    data = fh.read()
+    fh.close()
+    return data
+"""
+    got = lifecycle.lint_source(leak, "<fx>")
+    assert [f.rule for f in got] == ["handle-leak"], got
+
+    exempt = """
+def g(p):
+    fh = open(p)
+    return fh
+
+def h(self, p):
+    self._fh = open(p)
+
+def i(p):
+    with open(p) as fh:
+        return fh.read()
+"""
+    assert lifecycle.lint_source(exempt, "<fx>") == []
+
+
+# ---------------------------------------------------------------------------
+# locks: unlocked-read rule
+# ---------------------------------------------------------------------------
+
+_LOCKS_FIXTURE = '''
+@guarded_by("_lock", "_generation", "_staged")
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._staged = None
+
+class Engine:
+    def __init__(self):
+        self._watcher = Watcher()
+
+    def bad(self):
+        return self._watcher._generation
+
+    def ok_under_owners_lock(self):
+        with self._watcher._lock:
+            return self._watcher._generation
+
+    def ok_method_call(self):
+        return self._watcher.take()
+'''
+
+
+def test_unlocked_read_flags_cross_class_access_only():
+    import ast
+
+    from consensusml_tpu.analysis import locks
+
+    guarded = locks._guarded_classes_in_tree(ast.parse(_LOCKS_FIXTURE))
+    assert guarded == {"Watcher": {"_generation": "_lock", "_staged": "_lock"}}
+    got = [
+        f for f in locks.lint_source(_LOCKS_FIXTURE, "<fx>", guarded)
+        if f.rule == "unlocked-read"
+    ]
+    assert [(f.symbol, f.detail) for f in got] == [
+        ("Engine.bad", "_generation")
+    ]
+    # without the package map the per-file rules still run, silently
+    # skipping the cross-class scan
+    assert locks.lint_source(_LOCKS_FIXTURE, "<fx>") != None  # noqa: E711
+
+
+def test_unlocked_read_package_scan_is_clean():
+    from consensusml_tpu.analysis import locks
+
+    got = [
+        f
+        for f in locks.lint_paths([os.path.join(REPO, "consensusml_tpu")], REPO)
+        if f.rule == "unlocked-read"
+    ]
+    assert got == [], [f.id for f in got]
+
+
+# ---------------------------------------------------------------------------
+# conformance: recorded traces of the REAL classes replay in the models
+# ---------------------------------------------------------------------------
+
+
+def test_pool_churn_trace_replays_and_free_lists_agree():
+    """The PR 17 randomized churn workload, recorded: every begin /
+    adopt / extend / pin / unpin / shrink / release the real BlockPool
+    performs is a legal model action in sequence, and at the end the
+    model's LIFO free stack equals the pool's actual free list —
+    block-id-exact conformance, not just shape conformance."""
+    from consensusml_tpu.analysis.conformance import (
+        RecordingPool,
+        replay_pool_trace,
+    )
+    from consensusml_tpu.serve.pool import blocks as P
+
+    rng = np.random.default_rng(7)
+    pool = RecordingPool(num_slots=8, max_len=20, block_size=4, num_blocks=25)
+    live: set[int] = set()
+    pinned: list[int] = []
+    for _ in range(400):
+        op = rng.integers(0, 6)
+        if op == 0 and len(live) < pool.num_slots:
+            slot = next(s for s in range(pool.num_slots) if s not in live)
+            pool.begin(slot)
+            if live and rng.random() < 0.5:
+                donor = int(rng.choice(sorted(live)))
+                owned = pool.owned(donor)
+                k = int(rng.integers(1, min(len(owned), 3) + 1))
+                pool.adopt(slot, owned[:k])
+            try:
+                pool.extend(slot, int(rng.integers(1, 3)))
+            except P.NoFreeBlocks:
+                pool.release(slot)
+            else:
+                live.add(slot)
+        elif op == 1 and live:
+            slot = int(rng.choice(sorted(live)))
+            if len(pool.owned(slot)) < pool.blocks_per_slot:
+                try:
+                    pool.extend(slot)
+                except P.NoFreeBlocks:
+                    pass
+        elif op == 2 and live:
+            slot = int(rng.choice(sorted(live)))
+            pool.shrink(slot, int(rng.integers(1, len(pool.owned(slot)) + 1)))
+        elif op == 3 and live:
+            slot = int(rng.choice(sorted(live)))
+            b = int(rng.choice(pool.owned(slot)))
+            pool.pin(b)
+            pinned.append(b)
+        elif op == 4 and pinned:
+            pool.unpin(pinned.pop(int(rng.integers(0, len(pinned)))))
+        elif op == 5 and live:
+            slot = int(rng.choice(sorted(live)))
+            pool.release(slot)
+            live.discard(slot)
+        pool.check()
+    for b in pinned:
+        pool.unpin(b)
+    for slot in sorted(live):
+        pool.release(slot)
+    pool.check()
+
+    assert len(pool.trace) > 200, "churn too small to mean anything"
+    final = replay_pool_trace(pool)
+    assert list(final[0]) == list(pool._free)
+
+
+def test_pool_trace_with_seeded_drift_fails_replay():
+    """Conformance is falsifiable: corrupt one recorded block id and
+    replay rejects the trace at that step."""
+    from consensusml_tpu.analysis.conformance import (
+        RecordingPool,
+        replay_pool_trace,
+    )
+
+    pool = RecordingPool(num_slots=2, max_len=20, block_size=4, num_blocks=8)
+    pool.begin(0)
+    pool.extend(0, 2)
+    pool.release(0)
+    # the real pool popped (1, 2); claim it popped (1, 5)
+    pool.trace[1] = ("extend", 0, (1, 5))
+    with pytest.raises(ConformanceError, match="step 1"):
+        replay_pool_trace(pool)
+
+
+def test_membership_pin_advance_trace_replays():
+    from consensusml_tpu.analysis.conformance import (
+        RecordingMembership,
+        replay_membership_trace,
+    )
+    from consensusml_tpu.topology.topologies import RingTopology
+
+    mc = RecordingMembership(RingTopology(4))
+    v0 = mc.pin()
+    mc.advance()
+    v1 = mc.pin()
+    mc.advance()
+    mc.release(v0)  # round against epoch 0 completes AFTER two advances
+    mc.release(v1)
+    final = replay_membership_trace(mc)
+    assert final is not None
+    # no residual pinned rounds
+    assert not mc.pinned_epochs()
+
+
+@pytest.mark.serving
+def test_engine_preempt_hotswap_run_replays_in_request_model(
+    tmp_path, monkeypatch
+):
+    """The acceptance e2e: a REAL engine run with recompute preemption
+    (8 streams vs 4 slots and 10 blocks) and a live hot-swap generation
+    flip, recorded through the engine's own wide-event request traces,
+    replays as a valid path of the request-lifecycle model — slot
+    aliasing, readmission-continuation accounting, and generation
+    monotonicity all checked step by step."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from consensusml_tpu.analysis.conformance import replay_request_registry
+    from consensusml_tpu.models.gpt2 import GPT2Config, GPT2LM
+    from consensusml_tpu.obs import requests as rq
+    from consensusml_tpu.serve import Engine, ServeConfig
+    from consensusml_tpu.serve.export import (
+        _write_meta,
+        bump_generation,
+        serving_meta,
+    )
+    from consensusml_tpu.serve.pool.hotswap import GenerationWatcher
+
+    # a fresh registry so the recording covers exactly this run
+    monkeypatch.setattr(rq, "_GLOBAL", rq.RequestTraceRegistry())
+
+    model = GPT2LM(
+        config=GPT2Config(
+            vocab_size=64, hidden=32, layers=2, heads=2, max_len=32,
+            dropout=0.0,
+        )
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    art = str(tmp_path / "art")
+    os.makedirs(art)
+    _write_meta(art, {"generation": 1, "config_name": "model-check-fixture"})
+
+    eng = Engine(
+        model, params,
+        ServeConfig(num_slots=4, max_len=32, max_new_tokens=24, num_blocks=10),
+    )
+    loader_calls = []
+
+    def loader(path):
+        loader_calls.append(path)
+        return serving_meta(path), params, None
+
+    eng._watcher = GenerationWatcher(
+        art, current_generation=0, poll_s=0.01, loader=loader
+    )
+    try:
+        rng = np.random.default_rng(3)
+        handles = [
+            eng.submit(rng.integers(0, 63, size=n).tolist(), 24)
+            for n in (3, 7, 8, 8, 4, 6, 8, 5)
+        ]
+        bump_generation(art)  # swap while the waves are in flight
+        for h in handles:
+            assert len(h.result(timeout=180).tokens) == 24
+        deadline = time.monotonic() + 30
+        while eng.generation < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        eng.shutdown(drain=True, timeout=60)
+
+    stats = eng.stats()
+    assert stats["evictions"] >= 1, stats  # preemption really happened
+    assert eng.generation >= 1 and loader_calls  # hot-swap really flipped
+
+    final = replay_request_registry(rq._GLOBAL, n_slots=4)
+    assert final is not None

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """cml-check: static analysis gate for the gossip training stack.
 
-Runs the seven analysis passes (see docs/static_analysis.md) and exits
+Runs the nine analysis passes (see docs/static_analysis.md) and exits
 non-zero on any finding not suppressed by the baseline file:
 
     python tools/cml_check.py --all                # the tier-1 gate
@@ -40,6 +40,19 @@ Passes:
   --docs        docs-drift: every consensusml_* metric family emitted
                 in code must appear in docs/observability.md, and doc
                 entries no code emits are flagged stale
+  --model       bounded explicit-state model checking of the serving
+                control-plane protocols: BlockPool/PrefixIndex
+                refcounts, request lifecycle x hot-swap generation
+                flips, membership epoch pin/advance — every
+                interleaving of the abstract actors, exhaustively;
+                a violation reports a BFS-minimal action trace, and
+                seeded-bug fixture models must each refute (PR 15
+                detector-broken pattern)
+  --lifecycle   resource-lifecycle escape lint: every pool
+                alloc/begin/extend/adopt/pin site, slot occupy, and
+                open()/socket handle must dominate its release on all
+                paths including exception edges; ownership transfer
+                (return/yield/store/pass) is the exemption
 
 Each run prints a per-pass wall-time line ([time] ...); the AST passes
 are budgeted <2 s each in tools/bench_diff.py's spec.
@@ -167,6 +180,21 @@ def run_passes(selected: list[str], roots: list[str], restricted: bool = False):
         findings += timed(
             "docs-drift", lambda: docs_drift.check_repo(_REPO_ROOT)
         )
+    if "lifecycle" in selected:
+        from consensusml_tpu.analysis import lifecycle
+
+        findings += timed(
+            "lifecycle", lambda: lifecycle.lint_paths(roots, _REPO_ROOT)
+        )
+    if "model" in selected:
+        from consensusml_tpu.analysis import protocol_models
+
+        findings += timed(
+            "model",
+            lambda: protocol_models.run_builtin(
+                roots=roots if restricted else None, repo_root=_REPO_ROOT
+            ),
+        )
     if "schedule" in selected:
         _force_cpu()
         from consensusml_tpu.analysis import schedule
@@ -197,7 +225,7 @@ def main(argv=None) -> int:
         prog="cml-check", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("--all", action="store_true", help="run all seven passes")
+    ap.add_argument("--all", action="store_true", help="run all nine passes")
     ap.add_argument("--host-sync", action="store_true")
     ap.add_argument("--schedule", action="store_true")
     ap.add_argument("--jaxpr", action="store_true")
@@ -205,6 +233,8 @@ def main(argv=None) -> int:
     ap.add_argument("--threads", action="store_true")
     ap.add_argument("--lockorder", action="store_true")
     ap.add_argument("--docs", action="store_true")
+    ap.add_argument("--model", action="store_true")
+    ap.add_argument("--lifecycle", action="store_true")
     ap.add_argument(
         "--paths", nargs="*", default=None,
         help="files/dirs for the AST passes (default: consensusml_tpu/)",
@@ -232,6 +262,8 @@ def main(argv=None) -> int:
             ("threads", args.threads),
             ("lockorder", args.lockorder),
             ("docs-drift", args.docs),
+            ("lifecycle", args.lifecycle),
+            ("model", args.model),
             ("schedule", args.schedule),
             ("jaxpr", args.jaxpr),
         )
@@ -284,7 +316,8 @@ def main(argv=None) -> int:
             # (report_stale off), so the entry cannot be re-found
             return False
         path_scoped = parts[0] in (
-            "host-sync", "locks", "threads", "lockorder"
+            "host-sync", "locks", "threads", "lockorder",
+            "lifecycle", "model",  # model ids carry the SUBJECT file
         )
         if path_scoped and args.paths is not None and len(parts) > 2:
             f = parts[2]
