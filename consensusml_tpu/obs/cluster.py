@@ -566,6 +566,44 @@ def _tenants_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def _fleet_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """The fleet plane: router/controller state written as a ``fleet``
+    snapshot extra (``tools/fleetctl.py --obs-snapshot``, the fleet
+    bench). Routers are disjoint front-ends, so their stream counters
+    SUM across snapshots; the per-replica table and canary state merge
+    last-writer-wins by replica name. None when no snapshot carries a
+    fleet section (non-fleet directories keep aggregating)."""
+    routers = 0
+    counts: dict[str, float] = {}
+    replicas: dict[str, dict[str, Any]] = {}
+    canary = None
+    events: list[dict] = []
+    for s in snaps:
+        fl = s.get("fleet")
+        if not isinstance(fl, dict):
+            continue
+        routers += 1
+        for k, v in (fl.get("router") or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                counts[k] = counts.get(k, 0) + v
+            elif k not in counts:
+                counts[k] = v
+        for name, row in (fl.get("replicas") or {}).items():
+            replicas[name] = row
+        if isinstance(fl.get("canary"), dict):
+            canary = fl["canary"]
+        events.extend(fl.get("events") or [])
+    if not routers:
+        return None
+    return {
+        "routers_reporting": routers,
+        "router": counts,
+        "replicas": replicas,
+        "canary": canary,
+        "events": sorted(events, key=lambda e: e.get("time_s", 0.0))[-32:],
+    }
+
+
 def _hbm_section(snaps: list[dict[str, Any]]) -> dict[str, Any] | None:
     """The three-way HBM reconciliation gauges (obs/memviz.py), worst
     rank per side — plus per-pair drift. None when no rank reconciled."""
@@ -913,6 +951,10 @@ def aggregate(
         # each snapshot's rollup (docs/observability.md "Wide events &
         # tenant accounting"); None when no snapshot carries one
         "tenants": _tenants_section(ranks + others),
+        # the fleet plane: router stream accounting + replica table +
+        # canary state from fleetctl/bench snapshots (docs/fleet.md);
+        # None when no snapshot carries a fleet extra
+        "fleet": _fleet_section(ranks + others),
         "flight_recorders": flightrecs,
         "clients": other_rows,
         "errors": errors,

@@ -143,6 +143,7 @@ class MetricsServer:
         alerts=None,
         tick_s: float = 0.0,
         events=None,
+        ready_fn=None,
     ):
         registry = registry if registry is not None else get_registry()
         tracer = tracer if tracer is not None else get_tracer()
@@ -158,6 +159,12 @@ class MetricsServer:
         # global log, a scrape never creates one
         self.events = events
         self.tick_s = float(tick_s)
+        # readiness hook: a zero-arg callable (e.g. ``lambda:
+        # engine.warmed``) consulted by /healthz — False turns the probe
+        # 503 so a fleet router places zero new streams here (a replica
+        # still paying warmup compiles must not take traffic). None
+        # keeps the pre-fleet behavior: tick freshness alone decides.
+        self.ready_fn = ready_fn
         server = self
 
         # /profile state: one capture at a time, process-wide semantics
@@ -325,15 +332,30 @@ class MetricsServer:
         if self.history is not None:
             last = self.history.last_record_s
             if last == last:  # not NaN: at least one record landed
-                age = round(now - last, 3)
+                # floor at server start: an OWNED tick cannot be stale
+                # before this server has lived a tick interval — an
+                # inherited process-global history may carry records
+                # from long before this server existed
+                age = round(now - max(last, self._started_s), 3)
             else:
                 age = round(now - self._started_s, 3)
         firing = len(self.alerts.firing()) if self.alerts is not None else 0
         ok = True
         if self.tick_s > 0 and age is not None:
             ok = age <= max(5.0 * self.tick_s, 10.0)
-        return (200 if ok else 503), {
-            "ok": ok,
+        # the warmup gate (docs/fleet.md): ready_fn False means the
+        # process is alive but must take zero NEW streams — same 503 a
+        # stale tick earns, with the reason split out so a fleet
+        # router's scrape can tell "warming" from "wedged"
+        ready = True
+        if self.ready_fn is not None:
+            try:
+                ready = bool(self.ready_fn())
+            except Exception:
+                ready = False
+        return (200 if ok and ready else 503), {
+            "ok": ok and ready,
+            "ready": ready,
             "time_s": now,
             "pid": os.getpid(),
             "tick_s": self.tick_s if self.tick_s > 0 else None,

@@ -282,6 +282,10 @@ class Engine:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._drained = threading.Event()
+        # readiness for fleet placement: set when a warmup() completes,
+        # so a router's /healthz poll never routes streams onto a
+        # replica still paying multi-second compiles (docs/fleet.md)
+        self._warmed = threading.Event()
 
         from consensusml_tpu.obs import get_request_registry
 
@@ -751,6 +755,7 @@ class Engine:
                     futs = [ex.submit(c) for c in chains]
                     for f in futs:
                         f.result()  # re-raise any chain's failure here
+            self._warmed.set()
             return self.compile_counts()
         cache = D.init_cache(self._dm, self.config.num_slots, self.max_len)
         for b in buckets if buckets is not None else self.buckets:
@@ -762,6 +767,7 @@ class Engine:
         self._decode_fn(
             self._params, cache, toks, jnp.zeros_like(toks), *samp
         )
+        self._warmed.set()
         return self.compile_counts()
 
     def watch(self, path: str, poll_s: float = 0.25):
@@ -1079,6 +1085,12 @@ class Engine:
         self._cost_ledger = ledger
         return rows
 
+    @property
+    def warmed(self) -> bool:
+        """True once a :meth:`warmup` has completed — the readiness bit
+        ``/healthz`` (and a fleet router's placement) gates on."""
+        return self._warmed.is_set()
+
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admitting; serve everything queued + in flight to
         completion. Returns True when fully drained (the SIGTERM path —
@@ -1127,6 +1139,7 @@ class Engine:
                 self._tokens_out / decode_time if decode_time > 0 else 0.0
             ),
             "generation": self._generation,
+            "warmed": self.warmed,
             "swaps": self._swaps,
             "evictions": self._evictions,
             "compile_counts": self.compile_counts(),

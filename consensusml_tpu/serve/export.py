@@ -46,6 +46,7 @@ __all__ = [
     "load_serving",
     "serving_meta",
     "bump_generation",
+    "pin_generation",
     "META_NAME",
     "DRAFT_SUBDIR",
 ]
@@ -220,6 +221,23 @@ def bump_generation(path: str) -> int:
         meta["generation"] = gen
         _write_meta(path, meta)
     return gen
+
+
+def pin_generation(path: str, meta: dict[str, Any]) -> int:
+    """Re-pin ``path`` to ``meta``'s content at a generation STRICTLY
+    above the current one. This is canary ROLLBACK (fleet/controller.py):
+    watchers reject regressed generations, so going "back" to a known
+    meta is a forward write — the old content under a new generation,
+    stamped ``rolled_back_from`` so the swap log shows why. Returns the
+    pinned generation."""
+    path = os.path.abspath(path)
+    with _generation_lock(path):
+        cur = int(serving_meta(path).get("generation", 0))
+        pinned = dict(meta)
+        pinned["generation"] = cur + 1
+        pinned["rolled_back_from"] = cur
+        _write_meta(path, pinned)
+    return cur + 1
 
 
 def serving_meta(path: str) -> dict[str, Any]:

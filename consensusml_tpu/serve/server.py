@@ -112,6 +112,11 @@ class ServeServer:
                     history=get_history(),
                     alerts=alerts,
                     tick_s=obs_tick_s,
+                    # readiness gates on warmup completion: until the
+                    # engine's warmup() finishes, /healthz reports 503
+                    # and a fleet router places zero streams here
+                    # (engines without the flag stay always-ready)
+                    ready_fn=lambda: bool(getattr(engine, "warmed", True)),
                 )
             except OSError:
                 self._sock.close()

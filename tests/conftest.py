@@ -154,6 +154,12 @@ _SLOW_TESTS = {
     "test_profile_endpoint_single_flight_and_rotation",
     "test_profile_capture_parses_via_xprof_summary_json",
     "test_engine_without_ledger_still_emits_unjoined",
+    # round-8 fleet tier: each spawns 2-3 real in-process engines (one
+    # warmup compile per replica). The fast tier pins the same router/
+    # controller logic on stub servers and fake handles (test_fleet.py).
+    "test_fleet_e2e_placement_and_kill_redispatch",
+    "test_fleet_e2e_canary_promote_and_rollback",
+    "test_fleet_e2e_affinity_tracks_single_engine_prefix_rate",
 }
 
 

@@ -86,6 +86,16 @@ def test_default_spec_is_well_formed():
     # overhead budget plus the rollup-must-balance gate
     assert "observability.wide_event_overhead_pct" in keys
     assert "observability.tenant_rollup_mismatch" in keys
+    # the fleet tier (ISSUE 20): zero lost streams, router overhead
+    # under 1% of a p50 request, scored placement no worse than
+    # round-robin on the imbalanced mix, zero recompiles after warmup on
+    # every replica, canary promoted inside the soak wall budget
+    assert "fleet.lost_streams" in keys
+    assert "fleet.router_overhead_pct" in keys
+    assert "fleet.placement_ttft_ratio" in keys
+    assert "fleet.zero_recompiles_after_warmup" in keys
+    assert "fleet.canary_promoted" in keys
+    assert "fleet.canary_soak_wall_s" in keys
 
 
 def test_wide_event_gates_enforced_on_fresh_result(tmp_path, capsys):
@@ -124,6 +134,51 @@ def test_wide_event_gates_enforced_on_fresh_result(tmp_path, capsys):
     ok = {r["key"]: r["status"] for r in doc["rows"]}
     assert ok["observability.wide_event_overhead_pct"] == "ok"
     assert ok["observability.tenant_rollup_mismatch"] == "ok"
+
+
+def test_fleet_gates_enforced_on_fresh_result(tmp_path, capsys):
+    """A fresh bench that lost an accepted stream, blew the router
+    overhead budget, or whose canary never promoted fails; the healthy
+    fleet shape passes every gate."""
+    mod = _tool()
+
+    def run(fleet):
+        fresh = {
+            "parsed": {"value": 2554.1, "vs_baseline": 1.02},
+            "fleet": fleet,
+        }
+        path = tmp_path / "fresh.json"
+        path.write_text(json.dumps(fresh))
+        rc = mod.main([str(path), "--json", "-"])
+        return rc, json.loads(capsys.readouterr().out)
+
+    healthy = {
+        "lost_streams": 0,
+        "router_overhead_pct": 0.2,
+        "placement_ttft_ratio": 0.7,
+        "zero_recompiles_after_warmup": True,
+        "canary_promoted": True,
+        "canary_soak_wall_s": 3.5,
+    }
+    rc, doc = run(healthy)
+    assert rc == 0, doc
+    blown = dict(
+        healthy,
+        lost_streams=1,
+        router_overhead_pct=2.0,
+        placement_ttft_ratio=1.4,
+        canary_promoted=False,
+    )
+    rc, doc = run(blown)
+    assert rc == 1
+    failed = {r["key"] for r in doc["rows"] if r["status"] == "regression"}
+    assert "fleet.lost_streams" in failed
+    assert "fleet.router_overhead_pct" in failed
+    assert "fleet.placement_ttft_ratio" in failed
+    assert "fleet.canary_promoted" in failed
+    ok = {r["key"]: r["status"] for r in doc["rows"]}
+    assert ok["fleet.zero_recompiles_after_warmup"] == "ok"
+    assert ok["fleet.canary_soak_wall_s"] == "ok"
 
 
 def test_analysis_budgets_enforced_on_fresh_result(tmp_path, capsys):

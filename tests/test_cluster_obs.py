@@ -579,3 +579,84 @@ def test_train_smoke_link_probes_and_cluster_report(tmp_path):
     assert probed, "link probes produced no per-edge histograms"
     assert all(l["wire_bytes_per_round"] for l in probed)
     assert doc["stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# fleet section (ISSUE 20): router snapshots merge + render
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_snapshots_merge_and_render(tmp_path, capsys):
+    """Two routers writing ``fleet`` snapshot extras (fleetctl
+    --obs-snapshot) merge into one cluster-report section: stream
+    counters SUM across routers, the replica table and canary state
+    merge by name / last-writer, and obs_report renders the fleet rows
+    (docs/fleet.md "Observability")."""
+    def fleet_doc(accepted, replicas, canary=None, events=()):
+        return {
+            "router": {
+                "policy": "score",
+                "accepted": accepted,
+                "completed": accepted - 1,
+                "rejected": 1,
+                "client_gone": 0,
+                "lost_streams": 0,
+                "redispatches": 2,
+                "affinity_hits": 3,
+            },
+            "replicas": replicas,
+            "canary": canary,
+            "events": list(events),
+        }
+
+    rep0 = {"r0": {"ready": True, "queue_depth": 1, "generation": 2,
+                   "hbm_free_bytes": 1 << 20, "firing": []}}
+    rep1 = {"r1": {"ready": False, "queue_depth": None, "generation": None,
+                   "hbm_free_bytes": None, "firing": ["serve-queue-full"]}}
+    ClusterWriter(str(tmp_path), rank=0, role="router").write(
+        extra={"fleet": fleet_doc(
+            10, rep0,
+            events=[{"time_s": 2.0, "kind": "canary-promote",
+                     "replicas": ["r1"]}],
+        )}
+    )
+    ClusterWriter(str(tmp_path), rank=1, role="router").write(
+        extra={"fleet": fleet_doc(
+            4, rep1,
+            canary={"state": "promoted", "replica": "r0",
+                    "target_generation": 2},
+            events=[{"time_s": 1.0, "kind": "canary-start",
+                     "replica": "r0"}],
+        )}
+    )
+    # a third, fleet-less rank must not disturb the section
+    ClusterWriter(str(tmp_path), rank=2, role="train").write(round=1)
+
+    doc = aggregate(str(tmp_path))
+    fl = doc["fleet"]
+    assert fl["routers_reporting"] == 2
+    assert fl["router"]["accepted"] == 14  # summed across routers
+    assert fl["router"]["completed"] == 12
+    assert fl["router"]["rejected"] == 2
+    assert fl["router"]["policy"] == "score"  # non-numeric: first wins
+    assert set(fl["replicas"]) == {"r0", "r1"}
+    assert fl["canary"]["state"] == "promoted"
+    assert [e["kind"] for e in fl["events"]] == [
+        "canary-start", "canary-promote",  # time-sorted across ranks
+    ]
+
+    mod = _tool("obs_report")
+    assert mod.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet (2 router(s), policy=score)" in out
+    assert "accepted=14" in out and "lost=0" in out
+    assert "canary: state=promoted replica=r0 target_gen=2" in out
+    assert "event: canary-start" in out
+
+    # a directory with no fleet snapshots carries no fleet section
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    ClusterWriter(str(bare), rank=0).write(round=1)
+    assert aggregate(str(bare)).get("fleet") is None
+    assert mod.main([str(bare)]) == 0
+    assert "fleet (" not in capsys.readouterr().out

@@ -1,0 +1,978 @@
+"""Fleet tier: router placement, re-dispatch, supervision, canary (ISSUE 20).
+
+Pinned properties:
+
+- **Readiness gate** — ``/healthz`` answers 503 until the engine's
+  warmup completes; a warming replica takes zero new streams.
+- **Placement** — the router scores KV headroom per queued request over
+  scraped signals; not-ready replicas take nothing; affinity keeps a
+  (tenant, prefix)'s repeats on the replica whose prefix index is warm.
+- **Re-dispatch** — a queue-full reject, dead connection, or cancelled
+  terminal re-dispatches the stream as a CONTINUATION (prompt = ids +
+  tokens already streamed, budget reduced) so an accepted stream is
+  never lost.
+- **Canary rollout** — the controller bumps ONE replica's artifact
+  generation, soaks it against the alert plane, then promotes
+  fleet-wide or rolls back by re-pinning the old meta FORWARD.
+
+Fast tests run against stub line-JSON servers and fake replica handles
+(no jax); the ``test_fleet_e2e_*`` tests spawn real in-process engines
+and are slow-marked (tests/conftest.py).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from consensusml_tpu.fleet import (
+    CanaryState,
+    ExternalReplica,
+    FleetController,
+    FleetRouter,
+)
+from consensusml_tpu.fleet.replicas import _http_json, scrape_signals
+from consensusml_tpu.fleet.router import affinity_key, placement_score
+from consensusml_tpu.serve.export import (
+    META_NAME,
+    bump_generation,
+    pin_generation,
+    serving_meta,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# Stub plumbing: line-JSON replica servers, fake handles, a fake fleet
+# ---------------------------------------------------------------------------
+
+
+class _StubServer:
+    """Minimal line-JSON server standing in for one ServeServer replica:
+    ``behavior(req, wfile)`` scripts what each accepted stream does
+    (serve, die mid-stream, reject). Received requests are recorded."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior
+        self.requests: list[dict] = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(32)
+        self._sock.settimeout(0.2)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+        self._sock.close()
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                f = conn.makefile("rwb")
+                line = f.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                self.requests.append(req)
+                self.behavior(req, f)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def _serve_all(base):
+    """Behavior: stream the full budget (tokens base+i), clean terminal."""
+
+    def behavior(req, f):
+        toks = [base + i for i in range(int(req["max_new_tokens"]))]
+        for t in toks:
+            f.write(json.dumps({"token": t}).encode() + b"\n")
+            f.flush()
+        f.write(
+            json.dumps(
+                {"done": True, "tokens": toks, "finish_reason": "max_tokens"}
+            ).encode()
+            + b"\n"
+        )
+        f.flush()
+
+    return behavior
+
+
+def _die_after(base, n):
+    """Behavior: stream n tokens then drop the connection (no terminal)
+    — what a killed replica's socket looks like from the router."""
+
+    def behavior(req, f):
+        for i in range(n):
+            f.write(json.dumps({"token": base + i}).encode() + b"\n")
+            f.flush()
+
+    return behavior
+
+
+def _cancel_after(base, n):
+    """Behavior: stream n tokens then a ``finish_reason="cancelled"``
+    terminal — the engine's non-drain shutdown sweep."""
+
+    def behavior(req, f):
+        for i in range(n):
+            f.write(json.dumps({"token": base + i}).encode() + b"\n")
+            f.flush()
+        f.write(
+            json.dumps(
+                {"done": True, "tokens": [], "finish_reason": "cancelled"}
+            ).encode()
+            + b"\n"
+        )
+        f.flush()
+
+    return behavior
+
+
+def _reject(req, f):
+    f.write(json.dumps({"error": "queue full: 0 free slots"}).encode() + b"\n")
+    f.flush()
+
+
+class _Fleet:
+    def __init__(self, reps):
+        self._reps = list(reps)
+
+    def replicas(self):
+        return list(self._reps)
+
+
+class _FakeHandle:
+    """A replica handle with scripted signals (router scoring tests)."""
+
+    def __init__(self, name, address, *, ready=True, hbm=None, queue=0):
+        self.name = name
+        self.address = address
+        self.artifact = None
+        self.ready = ready
+        self.hbm = hbm
+        self.queue = queue
+
+    def signals(self):
+        return {
+            "ready": self.ready,
+            "alive": True,
+            "hbm_free_bytes": self.hbm,
+            "queue_depth": self.queue,
+            "generation": None,
+            "swap_rejected_total": None,
+            "firing": [],
+        }
+
+
+class _FakeReplica:
+    """A replica handle with lifecycle verbs recorded (controller tests);
+    ``generation`` reads the artifact meta unless overridden — a fake
+    that never "swaps" models the watcher that never lands."""
+
+    def __init__(self, name, artifact=None, *, ready=True):
+        self.name = name
+        self.artifact = artifact
+        self.address = ("127.0.0.1", 1)
+        self.ready = ready
+        self.firing: list[str] = []
+        self.swap_rejected = None
+        self.gen_override = "meta"
+        self.drained = 0
+        self.respawned = 0
+
+    def is_ready(self):
+        return self.ready
+
+    def signals(self):
+        gen = None
+        if self.gen_override != "meta":
+            gen = self.gen_override
+        elif self.artifact:
+            gen = int(serving_meta(self.artifact).get("generation", 0))
+        return {
+            "ready": self.ready,
+            "alive": True,
+            "hbm_free_bytes": None,
+            "queue_depth": 0,
+            "generation": gen,
+            "swap_rejected_total": self.swap_rejected,
+            "firing": list(self.firing),
+        }
+
+    def drain(self, timeout=None):
+        self.drained += 1
+        return True
+
+    def respawn(self, block=True):
+        self.respawned += 1
+
+
+def _client(addr, ids, max_new, tenant=None):
+    """One stream through the router: returns (streamed_tokens, terminal
+    or error record)."""
+    with socket.create_connection(addr, timeout=30) as s:
+        f = s.makefile("rwb")
+        req = {"ids": list(ids), "max_new_tokens": max_new}
+        if tenant is not None:
+            req["tenant"] = tenant
+        f.write(json.dumps(req).encode() + b"\n")
+        f.flush()
+        toks = []
+        for line in f:
+            msg = json.loads(line)
+            if "error" in msg or msg.get("done"):
+                return toks, msg
+            toks.append(msg["token"])
+        return toks, None
+
+
+def _report_quiesced(router, timeout=5.0):
+    """The router bumps ``completed`` AFTER flushing the terminal to the
+    client, so an immediate ``report()`` can race the last bump: poll
+    until the accounting settles."""
+    deadline = time.time() + timeout
+    rep = router.report()
+    while rep["lost_streams"] != 0 and time.time() < deadline:
+        time.sleep(0.01)
+        rep = router.report()
+    return rep
+
+
+def _stub_art(tmp_path, name, generation=1):
+    d = tmp_path / name
+    d.mkdir()
+    (d / META_NAME).write_text(
+        json.dumps({"config_name": "stub", "generation": generation})
+    )
+    return str(d)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: /healthz readiness gates on warmup completion
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self):
+        self.warmed = False
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+
+def test_healthz_gates_on_engine_warmup():
+    """A replica still paying warmup compiles answers 503 on /healthz
+    (ready False) and flips to 200 the moment warmup completes — the
+    signal the fleet router places zero streams on."""
+    from consensusml_tpu.serve.server import ServeServer
+
+    eng = _StubEngine()
+    server = ServeServer(eng, metrics_port=0)
+    try:
+        host, port = server.metrics_address
+        url = f"http://{host}:{port}/healthz"
+        code, hz = _http_json(url)
+        assert code == 503
+        assert hz["ready"] is False and hz["ok"] is False
+        # the scrape the router runs sees the same thing
+        sig = scrape_signals((host, port))
+        assert sig["ready"] is False and sig["alive"] is True
+
+        eng.warmed = True
+        code, hz = _http_json(url)
+        assert code == 200
+        assert hz["ready"] is True and hz["ok"] is True
+        sig = scrape_signals((host, port))
+        assert sig["ready"] is True
+        # untouched gauges scrape as absent, never NaN (NaN would
+        # poison placement_score's sort tuple)
+        for k in ("hbm_free_bytes", "queue_depth", "generation",
+                  "swap_rejected_total"):
+            v = sig[k]
+            assert v is None or v == v, f"{k} scraped as NaN"
+    finally:
+        server.shutdown(drain=False)
+
+
+def test_scrape_signals_unreachable_means_not_ready():
+    sig = scrape_signals(("127.0.0.1", 9))  # nothing listens on discard
+    assert sig["ready"] is False and sig["alive"] is False
+    assert scrape_signals(None)["ready"] is False
+
+
+# ---------------------------------------------------------------------------
+# Placement units: affinity key, score ordering
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_tenant_and_prefix_sensitive():
+    a = affinity_key("t0", [1, 2, 3, 4])
+    assert a == affinity_key("t0", [1, 2, 3, 4])  # deterministic
+    assert a != affinity_key("t1", [1, 2, 3, 4])  # tenant-sensitive
+    assert a != affinity_key("t0", [1, 2, 3, 5])  # prefix-sensitive
+    # only the first n_tokens ids participate: a long tail past the
+    # prefix window does not split the key
+    long0 = affinity_key("t0", list(range(16)) + [99])
+    long1 = affinity_key("t0", list(range(16)) + [77])
+    assert long0 == long1
+    assert affinity_key(None, [1]) == affinity_key(None, [1])
+
+
+def test_placement_score_orders_headroom_then_queue():
+    hi = placement_score({"hbm_free_bytes": 100.0, "queue_depth": 0})
+    lo = placement_score({"hbm_free_bytes": 10.0, "queue_depth": 0})
+    assert hi > lo  # more headroom wins
+    idle = placement_score({"hbm_free_bytes": 100.0, "queue_depth": 0})
+    busy = placement_score({"hbm_free_bytes": 100.0, "queue_depth": 9})
+    assert idle > busy  # headroom per queued request
+    # no headroom gauge at all: least-queue tiebreak still orders
+    q0 = placement_score({"hbm_free_bytes": None, "queue_depth": 0})
+    q5 = placement_score({"hbm_free_bytes": None, "queue_depth": 5})
+    assert q0 > q5
+    # NaN gauges (a replica that never took a stream exposes NaN until
+    # first set) read as "no signal" — the score stays finite and
+    # totally ordered, so a fresh replica is never starved
+    nan = float("nan")
+    fresh = placement_score({"hbm_free_bytes": 100.0, "queue_depth": nan})
+    assert fresh == placement_score(
+        {"hbm_free_bytes": 100.0, "queue_depth": 0}
+    )
+    blank = placement_score({"hbm_free_bytes": nan, "queue_depth": nan})
+    assert blank == placement_score(
+        {"hbm_free_bytes": None, "queue_depth": 0}
+    )
+    assert fresh > blank > q5
+
+
+def test_router_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        FleetRouter(_Fleet([]), policy="lowest_latency")
+
+
+# ---------------------------------------------------------------------------
+# Router: scoring, not-ready exclusion, affinity (fake handles + stubs)
+# ---------------------------------------------------------------------------
+
+
+def test_router_scores_headroom_and_skips_not_ready():
+    """All placements land on the big-headroom replica; the not-ready
+    handle (and the queue-crushed one) take zero new streams."""
+    big = _StubServer(_serve_all(100))
+    small = _StubServer(_serve_all(200))
+    try:
+        handles = [
+            _FakeHandle("big", big.address, hbm=100e6, queue=0),
+            _FakeHandle("small", small.address, hbm=1e6, queue=0),
+            _FakeHandle("warming", ("127.0.0.1", 1), ready=False, hbm=1e9),
+        ]
+        router = FleetRouter(
+            _Fleet(handles), policy="score", scrape_s=0.05, backoff_s=0.01
+        )
+        try:
+            for i in range(5):  # distinct prompts: no affinity carryover
+                toks, term = _client(router.address, [10 + i, 20 + i], 3)
+                assert term["done"] and toks == [100, 101, 102]
+                assert term["replica"] == "big"
+            rep = _report_quiesced(router)
+            assert rep["placements"] == {"big": 5}
+            assert rep["lost_streams"] == 0
+            assert len(small.requests) == 0
+        finally:
+            router.shutdown()
+    finally:
+        big.close()
+        small.close()
+
+
+def test_router_round_robin_rotates_over_ready_set():
+    a = _StubServer(_serve_all(100))
+    b = _StubServer(_serve_all(200))
+    try:
+        handles = [
+            _FakeHandle("a", a.address, hbm=100e6),
+            _FakeHandle("b", b.address, hbm=1e6),
+        ]
+        router = FleetRouter(_Fleet(handles), policy="round_robin")
+        try:
+            for i in range(6):
+                _toks, term = _client(router.address, [i], 2)
+                assert term["done"]
+            rep = _report_quiesced(router)
+            # rotation ignores headroom: the split is even
+            assert rep["placements"] == {"a": 3, "b": 3}
+            assert rep["policy"] == "round_robin"
+        finally:
+            router.shutdown()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_affinity_repeats_and_breaks_on_deep_queue():
+    """Repeats of one (tenant, prefix) ride the same replica (its prefix
+    index is warm); once that replica's queue is past the affinity
+    bound, placement falls back to score and moves off it."""
+    a = _StubServer(_serve_all(100))
+    b = _StubServer(_serve_all(200))
+    try:
+        ha = _FakeHandle("a", a.address, hbm=50e6)
+        hb = _FakeHandle("b", b.address, hbm=50e6)
+        router = FleetRouter(
+            _Fleet([ha, hb]),
+            policy="score",
+            scrape_s=0.05,
+            affinity_max_queue=4,
+        )
+        try:
+            ids = [7, 8, 9]
+            first = _client(router.address, ids, 2, tenant="acme")[1]
+            pinned = first["replica"]
+            for _ in range(3):
+                term = _client(router.address, ids, 2, tenant="acme")[1]
+                assert term["replica"] == pinned
+            rep = _report_quiesced(router)
+            assert rep["affinity_hits"] == 3
+            assert rep["placements"][pinned] == 4
+
+            # crush the pinned replica's queue past affinity_max_queue;
+            # the next repeat must place elsewhere
+            (ha if pinned == "a" else hb).queue = 50
+            time.sleep(0.2)  # let the scrape loop publish the new depth
+            term = _client(router.address, ids, 2, tenant="acme")[1]
+            assert term["replica"] != pinned
+        finally:
+            router.shutdown()
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Router: re-dispatch continuations (dead conn, cancelled, queue-full)
+# ---------------------------------------------------------------------------
+
+
+def test_router_redispatch_resumes_stream_after_replica_death():
+    """Replica b dies after streaming 2 tokens; the stream resumes on a
+    as a continuation (prompt = ids + the 2 streamed tokens, budget
+    reduced) and the client sees one unbroken 6-token stream."""
+    good = _StubServer(_serve_all(900))
+    dying = _StubServer(_die_after(500, 2))
+    try:
+        reps = [  # equal scores: the name tiebreak picks "b" (max) first
+            ExternalReplica(good.address, name="a"),
+            ExternalReplica(dying.address, name="b"),
+        ]
+        router = FleetRouter(
+            _Fleet(reps), scrape_s=0.05, backoff_s=0.01, max_retries=4
+        )
+        try:
+            toks, term = _client(router.address, [1, 2, 3], 6)
+            assert toks == [500, 501, 900, 901, 902, 903]
+            assert term["done"] and term["tokens"] == toks
+            assert term["redispatches"] == 1
+            assert term["replica"] == "a"
+            # the continuation carried the tokens already streamed and
+            # the reduced budget
+            assert dying.requests[0]["ids"] == [1, 2, 3]
+            assert dying.requests[0]["max_new_tokens"] == 6
+            assert good.requests[0]["ids"] == [1, 2, 3, 500, 501]
+            assert good.requests[0]["max_new_tokens"] == 4
+            rep = _report_quiesced(router)
+            assert rep["lost_streams"] == 0
+            assert rep["redispatches"] == 1
+        finally:
+            router.shutdown()
+    finally:
+        good.close()
+        dying.close()
+
+
+def test_router_redispatch_on_cancelled_terminal():
+    """``finish_reason="cancelled"`` (the kill sweep's terminal) is a
+    re-dispatch trigger, not a completion."""
+    good = _StubServer(_serve_all(900))
+    killed = _StubServer(_cancel_after(500, 2))
+    try:
+        reps = [
+            ExternalReplica(good.address, name="a"),
+            ExternalReplica(killed.address, name="b"),
+        ]
+        router = FleetRouter(
+            _Fleet(reps), scrape_s=0.05, backoff_s=0.01, max_retries=4
+        )
+        try:
+            toks, term = _client(router.address, [4, 5], 4)
+            assert toks == [500, 501, 900, 901]
+            assert term["done"] and term["replica"] == "a"
+            assert term["redispatches"] == 1
+            assert good.requests[0]["ids"] == [4, 5, 500, 501]
+            assert _report_quiesced(router)["lost_streams"] == 0
+        finally:
+            router.shutdown()
+    finally:
+        good.close()
+        killed.close()
+
+
+def test_router_queue_full_reject_retries_next_best():
+    good = _StubServer(_serve_all(900))
+    full = _StubServer(_reject)
+    try:
+        reps = [
+            ExternalReplica(good.address, name="a"),
+            ExternalReplica(full.address, name="b"),
+        ]
+        router = FleetRouter(
+            _Fleet(reps), scrape_s=0.05, backoff_s=0.01, max_retries=4
+        )
+        try:
+            toks, term = _client(router.address, [1], 3)
+            assert toks == [900, 901, 902]
+            assert term["done"] and term["redispatches"] == 1
+            rep = _report_quiesced(router)
+            assert rep["completed"] == 1 and rep["lost_streams"] == 0
+        finally:
+            router.shutdown()
+    finally:
+        good.close()
+        full.close()
+
+
+def test_router_all_rejecting_yields_error_not_lost_stream():
+    full0 = _StubServer(_reject)
+    full1 = _StubServer(_reject)
+    try:
+        reps = [
+            ExternalReplica(full0.address, name="a"),
+            ExternalReplica(full1.address, name="b"),
+        ]
+        router = FleetRouter(
+            _Fleet(reps), scrape_s=0.05, backoff_s=0.01, max_retries=3
+        )
+        try:
+            toks, term = _client(router.address, [1], 3)
+            assert toks == []
+            assert "error" in term and "queue full" in term["error"]
+            rep = _report_quiesced(router)
+            assert rep["rejected"] == 1
+            assert rep["lost_streams"] == 0
+        finally:
+            router.shutdown()
+    finally:
+        full0.close()
+        full1.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller: canary promote / rollback, sick drains, pin_generation
+# ---------------------------------------------------------------------------
+
+
+def test_pin_generation_is_a_forward_write(tmp_path):
+    art = _stub_art(tmp_path, "art", generation=3)
+    old = serving_meta(art)
+    bump_generation(art)  # 4
+    bump_generation(art)  # 5: the "bad" canary content
+    pinned = pin_generation(art, old)
+    assert pinned == 6
+    meta = serving_meta(art)
+    assert meta["generation"] == 6  # strictly above — watchers accept
+    assert meta["rolled_back_from"] == 5
+    assert meta["config_name"] == "stub"
+
+
+def test_controller_canary_promotes_after_healthy_soak(tmp_path):
+    arts = [_stub_art(tmp_path, f"art{i}") for i in range(3)]
+    reps = [_FakeReplica(f"r{i}", arts[i]) for i in range(3)]
+    c = FleetController(_Fleet(reps), soak_s=1.0, restart_sick=False)
+    rec = c.start_canary(now=100.0)
+    assert rec["replica"] == "r0" and rec["target_generation"] == 2
+    # ONE replica's artifact advanced; the rest still serve the old gen
+    assert serving_meta(arts[0])["generation"] == 2
+    assert serving_meta(arts[1])["generation"] == 1
+    doc = c.step(now=100.5)  # swapped, but the soak window is still open
+    assert doc["canary"]["state"] == CanaryState.SOAKING
+    doc = c.step(now=101.1)
+    assert doc["canary"]["state"] == CanaryState.PROMOTED
+    assert sorted(doc["canary"]["promoted"]) == ["r1", "r2"]
+    assert serving_meta(arts[1])["generation"] == 2
+    assert serving_meta(arts[2])["generation"] == 2
+    kinds = [e["kind"] for e in c.events()]
+    assert "canary-start" in kinds and "canary-promote" in kinds
+
+
+def test_controller_canary_rolls_back_on_alert(tmp_path):
+    arts = [_stub_art(tmp_path, f"art{i}") for i in range(2)]
+    reps = [_FakeReplica(f"r{i}", arts[i]) for i in range(2)]
+    c = FleetController(_Fleet(reps), soak_s=5.0, restart_sick=False)
+    c.start_canary(now=0.0)
+    reps[0].firing = ["spec-acceptance-collapse"]
+    doc = c.step(now=0.2)
+    assert doc["canary"]["state"] == CanaryState.ROLLED_BACK
+    assert "spec-acceptance-collapse" in doc["canary"]["reason"]
+    meta = serving_meta(arts[0])
+    assert meta["generation"] == 3  # old meta re-pinned ABOVE the canary
+    assert meta["rolled_back_from"] == 2
+    assert serving_meta(arts[1])["generation"] == 1  # never touched
+    # the rollout is resolved: a new canary may start
+    reps[0].firing = []
+    assert c.start_canary(now=10.0)["target_generation"] == 4
+
+
+def test_controller_canary_rolls_back_on_swap_rejection_growth(tmp_path):
+    art = _stub_art(tmp_path, "art")
+    rep = _FakeReplica("r0", art)
+    rep.swap_rejected = 0
+    c = FleetController(_Fleet([rep]), soak_s=5.0, restart_sick=False)
+    c.start_canary(now=0.0)
+    rep.swap_rejected = 2  # the staged generation is being refused
+    doc = c.step(now=0.2)
+    assert doc["canary"]["state"] == CanaryState.ROLLED_BACK
+    assert "swap-rejections(gauge)" in doc["canary"]["reason"]
+
+
+def test_controller_canary_rolls_back_when_swap_never_lands(tmp_path):
+    art = _stub_art(tmp_path, "art")
+    rep = _FakeReplica("r0", art)
+    rep.gen_override = 1  # the watcher never picks the bump up
+    c = FleetController(
+        _Fleet([rep]), soak_s=0.1, soak_timeout_s=5.0, restart_sick=False
+    )
+    c.start_canary(now=0.0)
+    doc = c.step(now=1.0)
+    assert doc["canary"]["state"] == CanaryState.SOAKING  # still waiting
+    doc = c.step(now=6.0)
+    assert doc["canary"]["state"] == CanaryState.ROLLED_BACK
+    assert doc["canary"]["reason"] == ["swap-never-landed"]
+
+
+def test_controller_canary_requires_ready_replica_and_single_soak(tmp_path):
+    with pytest.raises(RuntimeError):
+        FleetController(_Fleet([_FakeReplica("r0")])).start_canary()
+    art = _stub_art(tmp_path, "art")
+    c = FleetController(_Fleet([_FakeReplica("r0", art)]), soak_s=60.0)
+    c.start_canary(now=0.0)
+    with pytest.raises(RuntimeError):
+        c.start_canary(now=1.0)  # one soak in flight at a time
+
+
+def test_controller_drains_sick_replica_after_sustained_burn(tmp_path):
+    reps = [_FakeReplica("r0"), _FakeReplica("r1")]
+    c = FleetController(_Fleet(reps), sick_after_s=0.5)
+    reps[1].firing = ["serve-queue-backlog"]
+    c.step(now=0.0)  # registers the burn, inside the grace window
+    assert reps[1].drained == 0
+    c.step(now=1.0)  # sustained past sick_after_s: drain + respawn
+    assert reps[1].drained == 1 and reps[1].respawned == 1
+    assert reps[0].drained == 0
+    kinds = [e["kind"] for e in c.events()]
+    assert "drain" in kinds and "respawn" in kinds
+    # a burn that CLEARS inside the window never drains
+    reps[0].firing = ["serve-ttft-burn-rate"]
+    c.step(now=2.0)
+    reps[0].firing = []
+    c.step(now=2.1)
+    c.step(now=9.0)
+    assert reps[0].drained == 0
+    # attach-mode handles have no lifecycle verbs: sick is a no-op
+    ext = ExternalReplica(("127.0.0.1", 1), name="att")
+    sick = _FakeReplica("att")
+    sick.drain = ext.drain  # RuntimeError, swallowed
+    sick.firing = ["serve-queue-backlog"]
+    c2 = FleetController(_Fleet([sick]), sick_after_s=0.0)
+    c2.step(now=0.0)
+    c2.step(now=1.0)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: loadgen multi-target mode
+# ---------------------------------------------------------------------------
+
+
+def test_run_loadgen_emits_per_target_report():
+    from tools.loadgen import run_loadgen
+
+    calls = [0]
+    lock = threading.Lock()
+
+    def submit(ids, max_new, ctx=None, sampling=None):
+        with lock:  # arrivals run on their own threads
+            calls[0] += 1
+            n = calls[0]
+        return {
+            "ttft_s": 0.01,
+            "latency_s": 0.02,
+            "tokens": [0] * max_new,
+            "target": "t0" if n % 2 else "t1",
+        }
+
+    rep = run_loadgen(
+        submit, n_requests=8, rate_rps=1000.0, prompt_lens=(2, 4),
+        vocab=16, max_new_tokens=3,
+    )
+    assert sorted(rep["targets"]) == ["t0", "t1"]
+    for block in rep["targets"].values():
+        assert block["completed"] == 4
+        assert block["tokens_out"] == 12
+        assert block["ttft_p99_ms"] > 0
+
+    def untagged(ids, max_new, ctx=None, sampling=None):
+        return {"ttft_s": 0.01, "latency_s": 0.02, "tokens": [0]}
+
+    rep = run_loadgen(
+        untagged, n_requests=2, rate_rps=1000.0, prompt_lens=(2, 4),
+        vocab=16, max_new_tokens=1,
+    )
+    assert rep["targets"] is None  # single-target path unchanged
+
+
+def test_multi_socket_submit_round_robins_and_tags():
+    from tools.loadgen import _multi_socket_submit
+
+    a = _StubServer(_serve_all(100))
+    b = _StubServer(_serve_all(200))
+    try:
+        submit = _multi_socket_submit([a.address, b.address])
+        seen = [submit([1, 2], 2)["target"] for _ in range(4)]
+        assert len(a.requests) == 2 and len(b.requests) == 2
+        assert len(set(seen)) == 2
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Slow e2e: real engines behind the router (names in conftest _SLOW_TESTS)
+# ---------------------------------------------------------------------------
+
+
+def _export_art(tmp_path, name="art0"):
+    import jax
+
+    from consensusml_tpu import configs
+    from consensusml_tpu.serve.export import export_serving
+    from consensusml_tpu.train import init_stacked_state
+
+    bundle = configs.build("gpt2_topk", "smoke")
+    state = init_stacked_state(
+        bundle.cfg, bundle.init_params, jax.random.key(0), bundle.world_size
+    )
+    art = str(tmp_path / name)
+    export_serving(art, state, config_name="gpt2_topk", round=0)
+    return art
+
+
+def _spawn_fleet(tmp_path, pool_blocks, lanes, *, prefix_cache=False):
+    import shutil
+
+    from consensusml_tpu.fleet import InProcessReplica, ReplicaSet
+    from consensusml_tpu.serve import ServeConfig, load_engine
+
+    art0 = _export_art(tmp_path)
+    arts = [art0]
+    for i in range(1, len(pool_blocks)):
+        d = str(tmp_path / f"art{i}")
+        shutil.copytree(art0, d)
+        arts.append(d)
+
+    def factory(i):
+        def build():
+            return load_engine(
+                arts[i],
+                ServeConfig(
+                    num_slots=lanes[i], max_len=32, max_new_tokens=4,
+                    kv_impl="paged", block_size=8,
+                    num_blocks=pool_blocks[i], prefix_cache=prefix_cache,
+                ),
+            )
+
+        return build
+
+    reps = [
+        InProcessReplica(factory(i), name=f"r{i}", artifact=arts[i])
+        for i in range(len(pool_blocks))
+    ]
+    fleet = ReplicaSet(reps)
+    fleet.spawn_all(block=True)
+    return reps, fleet, arts
+
+
+def test_fleet_e2e_placement_and_kill_redispatch(tmp_path):
+    """The acceptance anchor: 3 real replicas on an imbalanced pool mix.
+    Scored placement sends fewer streams to the tiny-pool replica than
+    round-robin does, and a mid-run ``kill()`` of a big replica loses
+    zero accepted streams — every client sees a complete stream, the
+    supervisor respawns the corpse."""
+    reps, fleet, _arts = _spawn_fleet(tmp_path, [8, 48, 48], [2, 8, 8])
+    try:
+        fleet.start_supervision()
+
+        def run_n(router, n):
+            for i in range(n):
+                ids = [1 + (5 * i + j) % 32 for j in range(4)]
+                _toks, term = _client(router.address, ids, 4)
+                assert term.get("done"), term
+
+        rr = FleetRouter(fleet, policy="round_robin", scrape_s=0.05)
+        try:
+            run_n(rr, 9)
+            rr_r0 = rr.report()["placements"].get("r0", 0)
+        finally:
+            rr.shutdown()
+        assert rr_r0 == 3  # rotation sends a third into the tiny pool
+
+        scored = FleetRouter(
+            fleet, policy="score", scrape_s=0.05, backoff_s=0.05
+        )
+        try:
+            run_n(scored, 9)
+            sc_rep = scored.report()
+            assert sc_rep["placements"].get("r0", 0) < rr_r0
+            assert sc_rep["lost_streams"] == 0
+
+            # kill drill: concurrent streams, r1 dies once a few land
+            n = 12
+            results = [None] * n
+            errs = []
+
+            def one(i):
+                try:
+                    ids = [1 + (7 * i + j) % 32 for j in range(4)]
+                    results[i] = _client(scored.address, ids, 4)
+                except Exception as e:  # noqa: BLE001 — recorded, asserted
+                    errs.append(repr(e))
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+                time.sleep(0.01)
+            deadline = time.time() + 120
+            while (
+                scored.report()["completed"] < 2 and time.time() < deadline
+            ):
+                time.sleep(0.01)
+            reps[1].kill()
+            for t in threads:
+                t.join(timeout=180)
+            assert not errs, errs
+            for toks, term in results:
+                assert term is not None and term.get("done"), (toks, term)
+            rep = _report_quiesced(scored)
+            assert rep["lost_streams"] == 0
+            assert rep["completed"] == rep["accepted"]
+            # the supervisor notices the corpse and respawns it
+            deadline = time.time() + 300
+            while not reps[1].is_ready() and time.time() < deadline:
+                time.sleep(0.1)
+            assert reps[1].is_ready()
+            assert reps[1].restarts >= 1
+        finally:
+            scored.shutdown()
+    finally:
+        fleet.stop(drain=True)
+
+
+def test_fleet_e2e_canary_promote_and_rollback(tmp_path):
+    """Canary against live engines + generation watchers: a healthy soak
+    promotes fleet-wide (every artifact and every engine reach the
+    target generation); a second canary under an injected
+    spec-acceptance-collapse alert rolls back by forward-pinning."""
+    reps, fleet, arts = _spawn_fleet(tmp_path, [16, 16], [4, 4])
+    ctl = FleetController(
+        fleet, poll_s=0.05, soak_s=0.3, restart_sick=False
+    )
+    try:
+        ctl.start()
+        rec = ctl.start_canary()
+        target = rec["target_generation"]
+
+        def wait_state(want, timeout=60.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                st = ctl.canary_status()
+                if st["state"] == want:
+                    return st
+                time.sleep(0.05)
+            raise AssertionError(
+                f"canary never reached {want}: {ctl.canary_status()}"
+            )
+
+        st = wait_state(CanaryState.PROMOTED)
+        for art in arts:
+            assert serving_meta(art)["generation"] >= target
+        # the watchers landed the swap on every engine, zero drain
+        deadline = time.time() + 60
+        while time.time() < deadline and any(
+            (r.signals()["generation"] or 0) < target for r in reps
+        ):
+            time.sleep(0.05)
+        assert all(
+            (r.signals()["generation"] or 0) >= target for r in reps
+        )
+
+        rec2 = ctl.start_canary()
+        victim = next(r for r in reps if r.name == rec2["replica"])
+        victim.inject_alert("spec-acceptance-collapse")
+        st = wait_state(CanaryState.ROLLED_BACK)
+        assert "spec-acceptance-collapse" in st["reason"]
+        meta = serving_meta(rec2["artifact"])
+        assert meta["rolled_back_from"] == rec2["target_generation"]
+        assert meta["generation"] == rec2["target_generation"] + 1
+        victim.clear_alerts()
+    finally:
+        ctl.stop()
+        fleet.stop(drain=True)
+
+
+def test_fleet_e2e_affinity_tracks_single_engine_prefix_rate(tmp_path):
+    """Same-tenant repeats of one shared prefix all ride one replica, so
+    the fleet's prefix hit-rate tracks what a single engine would see:
+    every repeat after the first hits that replica's prefix index."""
+    reps, fleet, _arts = _spawn_fleet(
+        tmp_path, [32, 32], [4, 4], prefix_cache=True
+    )
+    router = FleetRouter(fleet, policy="score", scrape_s=0.05)
+    try:
+        prefix = [1 + (i % 32) for i in range(16)]  # two full blocks
+        n = 6
+        homes = set()
+        for i in range(n):
+            _toks, term = _client(
+                router.address, prefix + [40 + i], 2, tenant="acme"
+            )
+            assert term.get("done"), term
+            homes.add(term["replica"])
+        assert len(homes) == 1  # affinity pinned the prefix to one home
+        home = next(r for r in reps if r.name == next(iter(homes)))
+        stats = home.engine.stats()["prefix_cache"]
+        # every request after the first re-used the cached prefix blocks
+        assert stats["hits"] >= n - 1
+        assert router.report()["affinity_hits"] >= n - 1
+    finally:
+        router.shutdown()
+        fleet.stop(drain=True)

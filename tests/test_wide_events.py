@@ -448,12 +448,18 @@ def test_parse_tenant_weights():
 
 
 class _StubWatcher:
-    """One staged swap, engine-thread protocol only (take/reject/stop)."""
+    """One staged swap, engine-thread protocol only (take/reject/stop).
+    ``gate`` defers the take until the ENGINE observes the condition —
+    evaluated inside its own tick, so a gated swap always lands while
+    the condition still holds (no submit-thread-vs-tick race)."""
 
-    def __init__(self, staged):
+    def __init__(self, staged, gate=None):
         self._staged = [staged]
+        self._gate = gate
 
     def take(self):
+        if self._gate is not None and not self._gate():
+            return None
         return self._staged.pop() if self._staged else None
 
     def reject(self, staged=None):  # pragma: no cover - mismatch path
@@ -509,19 +515,20 @@ def test_e2e_multitenant_join_rollup_and_tenant_slo(tmp_path, monkeypatch):
             if t["completed"]:
                 assert t["ttft_p99_ms"] > 0
 
-        # induce a drain-free hot swap under live tenant streams
+        # induce a drain-free hot swap under live tenant streams: the
+        # gated watcher lands the swap only on a tick where all 3
+        # streams are live, so the structural preemption (evictions
+        # > 0) is guaranteed even when warm jit caches let decode
+        # outrun this thread
+        engine._watcher = _StubWatcher(
+            StagedSwap(generation=2, params=engine._params, meta={}),
+            gate=lambda: engine._table.num_active >= 3,
+        )
         long_handles = [
             engine.submit([7, 8, 9, 10], max_new_tokens=16,
                           trace=TraceContext(f"swp-{i}"), tenant="batch")
             for i in range(3)
         ]
-        deadline = time.monotonic() + 60
-        while engine._table.num_active < 3 and time.monotonic() < deadline:
-            time.sleep(0.005)
-        assert engine._table.num_active >= 3
-        engine._watcher = _StubWatcher(
-            StagedSwap(generation=2, params=engine._params, meta={})
-        )
         results = [h.result(timeout=120) for h in long_handles]
         assert engine.generation == 2
         assert all(r.tenant == "batch" for r in results)

@@ -216,6 +216,30 @@ DEFAULT_SPEC = [
      "bound": 0.0},
     {"key": "serving.prefix_cache.zero_hit.overhead_pct",
      "direction": "max", "bound": 1.0},
+    # fleet block (ISSUE 20, docs/fleet.md): the 3-replica zipf run with
+    # a mid-run replica kill and a canary generation rollout must lose
+    # ZERO accepted streams (a dead replica's in-flight streams
+    # re-dispatch as continuations, never drop), the router's
+    # placement-decision overhead stays under 1% of p50 request latency,
+    # headroom-aware placement beats round-robin TTFT p99 on the same
+    # trace (ratio <= 1.0 under the imbalanced pool mix), every replica
+    # stays zero-recompile after warmup, and the canary rollout promotes
+    # within its soak wall budget
+    {"key": "fleet.lost_streams", "direction": "max", "bound": 0.0},
+    {"key": "fleet.router_overhead_pct", "direction": "max",
+     "bound": 1.0},
+    {"key": "fleet.ttft_p99_ms", "direction": "down",
+     "tol_pct": 50.0},
+    {"key": "fleet.latency_p99_ms", "direction": "down",
+     "tol_pct": 50.0},
+    {"key": "fleet.placement_ttft_ratio", "direction": "max",
+     "bound": 1.0},
+    {"key": "fleet.zero_recompiles_after_warmup",
+     "direction": "min", "bound": 1.0},
+    {"key": "fleet.canary_promoted", "direction": "min",
+     "bound": 1.0},
+    {"key": "fleet.canary_soak_wall_s", "direction": "max",
+     "bound": 120.0},
 ]
 
 

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Fleet entry point: spawn N serving replicas, route, supervise, canary.
+
+Brings up the whole fleet tier (docs/fleet.md) from one command:
+``--replicas N`` subprocess replicas (one ``python -m
+consensusml_tpu.fleet.replicas`` child per replica, each with its own
+copy-free view of ``--artifact`` unless ``--per-replica-artifacts``
+copies it N times so canary generations can diverge), the
+placement-aware :class:`~consensusml_tpu.fleet.FleetRouter` in front,
+the :class:`~consensusml_tpu.fleet.ReplicaSet` supervisor restarting
+dead replicas, and the :class:`~consensusml_tpu.fleet.FleetController`
+polling alerts for drain decisions. Clients speak the ordinary
+line-JSON serving protocol to the router's address::
+
+    python tools/fleetctl.py --artifact /tmp/art --replicas 3
+    # FLEET {"router": ["127.0.0.1", 43211], ...}
+    python tools/loadgen.py --connect 127.0.0.1:43211 --rate 50 --requests 200
+
+``--attach host:port[,host:port...]`` fronts already-running servers
+instead of spawning (metrics addresses via ``--attach-metrics`` enable
+scored placement; without them the router sees no headroom signals and
+score degenerates to least-known-queue). ``--canary`` starts a canary
+generation rollout once the fleet is ready and reports its outcome.
+One ``FLEET {json}`` status line prints per ``--status-every`` tick;
+``--obs-snapshot DIR`` writes the fleet state as a cluster snapshot
+extra each tick, so ``tools/obs_report.py DIR`` renders the fleet rows.
+
+Exit: Ctrl-C (or ``--duration`` elapsing) drains every replica —
+accepted streams complete, then the fleet exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _status(router, controller, fleet) -> dict:
+    return {
+        "router": router.report(),
+        "replicas": {
+            r.name: r.signals() for r in fleet.replicas()
+        },
+        "canary": controller.canary_status(),
+        "events": controller.events()[-16:],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--artifact", help="serving artifact dir to replicate")
+    src.add_argument("--attach", metavar="HOST:PORT,...",
+                     help="front already-running servers instead of spawning")
+    p.add_argument("--attach-metrics", metavar="HOST:PORT,...", default=None,
+                   help="metrics addresses for --attach targets (same "
+                        "order) — enables scored placement and health "
+                        "scrapes for attached replicas")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--per-replica-artifacts", action="store_true",
+                   help="copy --artifact once per replica so a canary "
+                        "generation can advance on ONE replica's dir "
+                        "(shared-dir fleets swap all replicas together)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="router port (0 = auto)")
+    p.add_argument("--policy", default="score",
+                   choices=("score", "round_robin"))
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--canary", action="store_true",
+                   help="start a canary generation rollout once ready")
+    p.add_argument("--soak-s", type=float, default=10.0)
+    p.add_argument("--status-every", type=float, default=5.0)
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="exit (drain) after this many seconds; 0 = run "
+                        "until Ctrl-C")
+    p.add_argument("--obs-snapshot", default=None, metavar="DIR",
+                   help="write the fleet state as a cluster snapshot "
+                        "extra each status tick (tools/obs_report.py "
+                        "renders the fleet rows)")
+    args = p.parse_args(argv)
+
+    from consensusml_tpu.fleet import (
+        ExternalReplica,
+        FleetController,
+        FleetRouter,
+        ReplicaSet,
+        SubprocessReplica,
+    )
+
+    replicas = []
+    if args.attach:
+        addrs = [a for a in args.attach.split(",") if a.strip()]
+        maddrs = (
+            [a for a in args.attach_metrics.split(",") if a.strip()]
+            if args.attach_metrics
+            else [None] * len(addrs)
+        )
+        if len(maddrs) != len(addrs):
+            print("error: --attach-metrics count must match --attach",
+                  file=sys.stderr)
+            return 2
+        for i, (a, m) in enumerate(zip(addrs, maddrs)):
+            h, _, pt = a.partition(":")
+            ma = None
+            if m:
+                mh, _, mp = m.partition(":")
+                ma = (mh, int(mp))
+            replicas.append(
+                ExternalReplica((h, int(pt)), ma, name=f"attach{i}")
+            )
+    else:
+        arts = [args.artifact] * args.replicas
+        if args.per_replica_artifacts:
+            import shutil
+            import tempfile
+
+            base = tempfile.mkdtemp(prefix="fleetctl_")
+            arts = []
+            for i in range(args.replicas):
+                d = os.path.join(base, f"art{i}")
+                shutil.copytree(args.artifact, d)
+                arts.append(d)
+        replicas = [
+            SubprocessReplica(
+                arts[i], name=f"r{i}", slots=args.slots,
+                max_new_tokens=args.max_new, host=args.host,
+            )
+            for i in range(args.replicas)
+        ]
+
+    fleet = ReplicaSet(replicas)
+    if not args.attach:
+        print("fleet: spawning (warmup gates readiness)...", flush=True)
+        fleet.spawn_all(block=True)
+        fleet.start_supervision()
+    router = FleetRouter(
+        fleet, host=args.host, port=args.port, policy=args.policy
+    )
+    controller = FleetController(fleet, soak_s=args.soak_s)
+    controller.start()
+    print(
+        "FLEET "
+        + json.dumps(
+            {
+                "router": list(router.address),
+                "policy": args.policy,
+                "replicas": {
+                    r.name: (list(r.address) if r.address else None)
+                    for r in fleet.replicas()
+                },
+            }
+        ),
+        flush=True,
+    )
+    if args.canary:
+        controller.start_canary()
+
+    writer = None
+    if args.obs_snapshot:
+        from consensusml_tpu.obs import ClusterWriter
+
+        writer = ClusterWriter(args.obs_snapshot, rank=0, role="fleetctl")
+
+    t0 = time.time()
+    rc = 0
+    try:
+        while True:
+            time.sleep(max(args.status_every, 0.5))
+            doc = _status(router, controller, fleet)
+            print("FLEET " + json.dumps(doc), flush=True)
+            if writer is not None:
+                writer.write(extra={"fleet": doc})
+            if args.duration and time.time() - t0 >= args.duration:
+                break
+            if (
+                args.canary
+                and not args.duration
+                and doc["canary"]["state"] in ("promoted", "rolled_back")
+            ):
+                break  # a bare --canary run exits once the rollout resolves
+    except KeyboardInterrupt:
+        print("fleet: draining (Ctrl-C)...", flush=True)
+    finally:
+        controller.stop()
+        final = _status(router, controller, fleet)
+        router.shutdown()
+        fleet.stop(drain=True)
+        if writer is not None:
+            writer.write(extra={"fleet": final})
+        print("FLEET " + json.dumps(final), flush=True)
+        if final["router"].get("lost_streams"):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,6 +277,26 @@ def run_loadgen(
     # the client-observed worst tail, with identity: each row's
     # trace_id/request_id resolves to a server-side RequestTrace
     slowest = sorted(results, key=lambda r: -r["latency_s"])[:8]
+    # per-target client SLOs: populated when the submitter tags results
+    # with "target" (multi-target / fleet mode); None single-target
+    target_report = None
+    if any("target" in r for r in results):
+        target_report = {}
+        for tgt in sorted({r.get("target", "?") for r in results}):
+            rs = [r for r in results if r.get("target", "?") == tgt]
+            gpct = lambda key, q: (
+                float(np.percentile([r[key] for r in rs], q))
+                if rs
+                else float("nan")
+            )
+            target_report[tgt] = {
+                "completed": len(rs),
+                "tokens_out": int(sum(len(r["tokens"]) for r in rs)),
+                "ttft_p50_ms": 1e3 * gpct("ttft_s", 50),
+                "ttft_p99_ms": 1e3 * gpct("ttft_s", 99),
+                "latency_p50_ms": 1e3 * gpct("latency_s", 50),
+                "latency_p99_ms": 1e3 * gpct("latency_s", 99),
+            }
     tenant_report = None
     if tenants:
         tenant_report = {}
@@ -310,6 +330,9 @@ def run_loadgen(
         # per-tenant client-observed SLOs (None without --tenants); the
         # server-side rollup twin is WideEventLog.rollup()
         "tenants": tenant_report,
+        # per-target client-observed SLOs (None unless the submitter
+        # tags results with "target", i.e. --targets multi-target mode)
+        "targets": target_report,
         "requests": n_requests,
         "completed": len(results),
         "errors": len(errors),
@@ -505,11 +528,40 @@ def _socket_submit(host: str, port: int):
     return submit
 
 
+def _multi_socket_submit(addrs: list[tuple[str, int]]):
+    """Round-robin submit over several ``HOST:PORT`` targets (``--targets``
+    multi-target mode — a poor-man's balancer for comparing N standalone
+    servers, or for driving a fleet's replicas directly, bypassing the
+    router). Each result is tagged ``target`` so ``run_loadgen`` emits a
+    per-target report block alongside the fleet-wide percentiles."""
+    singles = [
+        (f"{h}:{p}", _socket_submit(h, p)) for h, p in addrs
+    ]
+    lock = threading.Lock()
+    nxt = [0]
+
+    def submit(ids, max_new, ctx=None, sampling=None):
+        with lock:
+            name, one = singles[nxt[0] % len(singles)]
+            nxt[0] += 1
+        r = one(ids, max_new, ctx, sampling)
+        r["target"] = name
+        return r
+
+    return submit
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     tgt = p.add_mutually_exclusive_group(required=True)
     tgt.add_argument("--artifact", help="serving artifact dir (in-process engine)")
     tgt.add_argument("--connect", help="HOST:PORT of a running ServeServer")
+    tgt.add_argument("--targets", metavar="HOST:PORT,...",
+                     help="comma-separated HOST:PORT list: round-robin the "
+                          "arrivals over several running servers (or a "
+                          "fleet's replicas, bypassing the router) and "
+                          "report per-target SLO blocks alongside the "
+                          "aggregate")
     p.add_argument("--rate", type=float, default=20.0, help="Poisson arrivals/s")
     p.add_argument("--requests", type=int, default=100)
     p.add_argument("--max-new", type=int, default=16)
@@ -608,9 +660,23 @@ def main(argv=None) -> int:
             print("error: --swap-every needs --artifact (the generation "
                   "bump touches the artifact dir)", file=sys.stderr)
             return 2
-        host, _, port = args.connect.partition(":")
         vocab = 64  # socket mode cannot introspect the model; ids stay tiny
-        submit = _socket_submit(host, int(port))
+        if args.targets:
+            addrs = []
+            for part in args.targets.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                host, _, port = part.partition(":")
+                addrs.append((host, int(port)))
+            if not addrs:
+                print(f"error: no targets in {args.targets!r}",
+                      file=sys.stderr)
+                return 2
+            submit = _multi_socket_submit(addrs)
+        else:
+            host, _, port = args.connect.partition(":")
+            submit = _socket_submit(host, int(port))
 
     history = None
     if args.obs_snapshot:

@@ -228,6 +228,54 @@ def _render_tenants(doc: dict, add) -> None:
         )
 
 
+def _render_fleet(doc: dict, add) -> None:
+    """The fleet plane: router stream accounting (accepted vs completed
+    vs rejected — lost must be 0), per-replica table, canary state
+    (docs/fleet.md). Rendered only when a snapshot carried a fleet
+    extra, so non-fleet directories stay unchanged."""
+    fl = doc.get("fleet")
+    if not fl:
+        return
+    r = fl.get("router") or {}
+    add(
+        f"fleet ({fl.get('routers_reporting', 0)} router(s), "
+        f"policy={r.get('policy', '?')}): "
+        f"accepted={r.get('accepted', 0):g} completed={r.get('completed', 0):g} "
+        f"rejected={r.get('rejected', 0):g} client_gone={r.get('client_gone', 0):g} "
+        f"lost={r.get('lost_streams', 0):g} redispatches={r.get('redispatches', 0):g} "
+        f"affinity_hits={r.get('affinity_hits', 0):g}"
+    )
+    reps = fl.get("replicas") or {}
+    if reps:
+        add("  replica           ready  queue  gen   hbm free")
+        for name in sorted(reps):
+            row = reps[name]
+            add(
+                f"  {name:<16} {str(bool(row.get('ready'))):>6}  "
+                f"{_int_or_dash(row.get('queue_depth')):>5}  "
+                f"{_int_or_dash(row.get('generation')):>3}  "
+                f"{_fmt_b(row.get('hbm_free_bytes')):>9}"
+            )
+    canary = fl.get("canary")
+    if canary:
+        add(
+            f"  canary: state={canary.get('state')} "
+            f"replica={canary.get('replica', '-')} "
+            f"target_gen={canary.get('target_generation', '-')}"
+            + (
+                f" reason={','.join(canary.get('reason') or [])}"
+                if canary.get("reason")
+                else ""
+            )
+        )
+    for e in (fl.get("events") or [])[-8:]:
+        detail = {
+            k: v for k, v in e.items() if k not in ("time_s", "kind")
+        }
+        add(f"  event: {e.get('kind')} {json.dumps(detail, sort_keys=True)}")
+    add("")
+
+
 def render_text(doc: dict) -> str:
     lines: list[str] = []
     add = lines.append
@@ -398,6 +446,7 @@ def render_text(doc: dict) -> str:
                 f"{'-' if xf is None else format(xf, '.1f'):>7}"
             )
     add("")
+    _render_fleet(doc, add)
     _render_tenants(doc, add)
     hbm = doc.get("hbm")
     if hbm:
